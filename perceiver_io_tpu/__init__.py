@@ -1,0 +1,20 @@
+"""perceiver_io_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework with the
+capabilities of the `perceiver-io` reference library (Perceiver, Perceiver IO,
+Perceiver AR), re-designed TPU-first.
+
+Layering (mirrors the reference's 5-layer stack, reference
+``docs/library-design.md:1-9``, but idiomatic JAX):
+
+- ``ops``       — functional numerics: attention, position encodings, masks,
+                  Pallas kernels. Pure functions of arrays.
+- ``models``    — flax modules: the core Perceiver runtime plus task backends
+                  (text / vision / audio).
+- ``parallel``  — mesh construction, partitioning rules (dp/fsdp/tp/sp),
+                  jitted train-step factories, remat policies, ring attention.
+- ``data``      — tokenizers, datamodules, collators (NumPy until device_put).
+- ``training``  — trainer loop, optimizers/schedules, orbax checkpointing.
+- ``inference`` — KV-cache decode loops, samplers, task pipelines.
+- ``convert``   — weight import from the reference's torch checkpoints.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+from perceiver_io_tpu.models.core.adapter import (
+    ClassificationOutputAdapter,
+    InputAdapter,
+    TrainableQueryProvider,
+    rotary_frequencies,
+)
+from perceiver_io_tpu.models.core.config import (
+    ClassificationDecoderConfig,
+    DecoderConfig,
+    EncoderConfig,
+    PerceiverARConfig,
+    PerceiverIOConfig,
+    config_from_dict,
+    config_to_dict,
+)
+from perceiver_io_tpu.models.core.modules import (
+    CrossAttention,
+    CrossAttentionLayer,
+    MLP,
+    MultiHeadAttention,
+    PerceiverAR,
+    PerceiverDecoder,
+    PerceiverEncoder,
+    PerceiverIO,
+    SelfAttention,
+    SelfAttentionBlock,
+    SelfAttentionLayer,
+)

@@ -1,0 +1,84 @@
+"""Input/output adapters and query providers.
+
+Capability parity with reference ``perceiver/model/core/adapter.py:8-83``.
+Adapters transform task-specific input into the generic ``(B, M, C)`` encoder
+input; output adapters map decoder cross-attention output to task output;
+query providers supply the trainable latent / output query arrays.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.position import frequency_position_encoding, positions
+
+
+class InputAdapter(nn.Module):
+    """Base class: subclasses must expose ``num_input_channels``."""
+
+    @property
+    def num_input_channels(self) -> int:
+        raise NotImplementedError
+
+
+class TrainableQueryProvider(nn.Module):
+    """Learnable query array — the latent array in encoders and the output
+    query array in most decoders (reference ``adapter.py:63-83``)."""
+
+    num_queries: int
+    num_query_channels_: int
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_query_channels(self) -> int:
+        return self.num_query_channels_
+
+    @nn.compact
+    def __call__(self, x: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        query = self.param(
+            "query",
+            nn.initializers.normal(stddev=self.init_scale),
+            (self.num_queries, self.num_query_channels_),
+        )
+        return query[None].astype(self.dtype)
+
+
+class ClassificationOutputAdapter(nn.Module):
+    """Linear head over output queries; squeezes a singleton query dim
+    (reference ``adapter.py:39-49``)."""
+
+    num_classes: int
+    num_output_query_channels: int
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="linear",
+        )(x)
+        if x.shape[1] == 1:
+            x = x[:, 0]
+        return x
+
+
+def rotary_frequencies(x_shape, rotated_channels_per_head: int, abs_pos=None):
+    """Frequency position encoding used to build rotary embeddings for
+    Perceiver AR (the ``RotarySupport`` mixin, reference ``adapter.py:22-32``).
+
+    :param x_shape: ``(b, n)`` token-grid shape.
+    :param abs_pos: optional precomputed ``(b, n)`` positions (e.g. shifted
+        for left padding); defaults to ``0..n-1``.
+    :return: ``(b, n, rotated_channels_per_head)`` angles.
+    """
+    b, n = x_shape
+    if abs_pos is None:
+        abs_pos = positions(b, n)
+    return frequency_position_encoding(abs_pos, rotated_channels_per_head)

@@ -1,0 +1,156 @@
+"""Canonical hyperparameter dataclasses for the core Perceiver runtime.
+
+One config system serves the trainer, the CLI (flags are generated from these
+dataclasses), checkpoint metadata (serialized alongside orbax state) and the
+inference wrappers — mirroring the reference's single-dataclass design
+(``perceiver/model/core/config.py:5-83``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import asdict, dataclass, fields
+from typing import Any, Dict, Generic, Optional, Tuple, TypeVar
+
+
+@dataclass
+class EncoderConfig:
+    """Perceiver IO encoder hyperparameters (reference ``config.py:5-25``)."""
+
+    num_cross_attention_heads: int = 8
+    num_cross_attention_qk_channels: Optional[int] = None
+    num_cross_attention_v_channels: Optional[int] = None
+    num_cross_attention_layers: int = 1
+    first_cross_attention_layer_shared: bool = False
+    cross_attention_widening_factor: int = 1
+    num_self_attention_heads: int = 8
+    num_self_attention_qk_channels: Optional[int] = None
+    num_self_attention_v_channels: Optional[int] = None
+    num_self_attention_layers_per_block: int = 8
+    num_self_attention_blocks: int = 1
+    first_self_attention_block_shared: bool = True
+    self_attention_widening_factor: int = 1
+    dropout: float = 0.0
+    init_scale: float = 0.02
+    freeze: bool = False
+
+    def base_kwargs(self, exclude=("freeze",)) -> Dict[str, Any]:
+        return _base_kwargs(self, EncoderConfig, exclude)
+
+
+@dataclass
+class DecoderConfig:
+    """Perceiver IO decoder hyperparameters (reference ``config.py:28-40``)."""
+
+    num_cross_attention_heads: int = 8
+    num_cross_attention_qk_channels: Optional[int] = None
+    num_cross_attention_v_channels: Optional[int] = None
+    cross_attention_widening_factor: int = 1
+    cross_attention_residual: bool = True
+    dropout: float = 0.0
+    init_scale: float = 0.02
+    freeze: bool = False
+
+    def base_kwargs(self, exclude=("freeze",)) -> Dict[str, Any]:
+        return _base_kwargs(self, DecoderConfig, exclude)
+
+
+@dataclass
+class ClassificationDecoderConfig(DecoderConfig):
+    num_output_queries: int = 1
+    num_output_query_channels: int = 256
+    num_classes: int = 100
+
+
+E = TypeVar("E", bound=EncoderConfig)
+D = TypeVar("D", bound=DecoderConfig)
+
+
+@dataclass
+class PerceiverIOConfig(Generic[E, D]):
+    """Container pairing an encoder and decoder config (reference
+    ``config.py:54-61``). ``activation_checkpointing`` maps to ``jax.remat``
+    on attention layers; CPU offload maps to a remat policy with host
+    offloading."""
+
+    encoder: E
+    decoder: D
+    num_latents: int
+    num_latent_channels: int
+    activation_checkpointing: bool = False
+    activation_offloading: bool = False
+
+
+@dataclass
+class PerceiverARConfig:
+    """Perceiver AR hyperparameters (reference ``config.py:64-78``)."""
+
+    num_heads: int = 8
+    max_heads_parallel: Optional[int] = None
+    num_self_attention_layers: int = 8
+    self_attention_widening_factor: int = 4
+    cross_attention_widening_factor: int = 4
+    cross_attention_dropout: float = 0.5
+    post_attention_dropout: float = 0.0
+    residual_dropout: float = 0.0
+    activation_checkpointing: bool = False
+    activation_offloading: bool = False
+
+    def base_kwargs(self, exclude=()) -> Dict[str, Any]:
+        return _base_kwargs(self, PerceiverARConfig, exclude)
+
+
+def _base_kwargs(config, base_class, exclude) -> Dict[str, Any]:
+    base_field_names = [f.name for f in fields(base_class) if f.name not in exclude]
+    return {k: v for k, v in asdict(config).items() if k in base_field_names}
+
+
+# Registry of config dataclasses by class name, for round-tripping nested
+# configs whose static field type is a TypeVar (PerceiverIOConfig is
+# Generic[E, D] — the concrete encoder/decoder class is only known at
+# runtime, so config_to_dict records it under "_type").
+_CONFIG_REGISTRY: Dict[str, type] = {}
+
+
+def register_config(cls):
+    """Class decorator: make a config dataclass round-trippable through
+    :func:`config_to_dict` / :func:`config_from_dict`."""
+    _CONFIG_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+for _cls in (EncoderConfig, DecoderConfig, ClassificationDecoderConfig, PerceiverIOConfig, PerceiverARConfig):
+    register_config(_cls)
+
+
+def config_to_dict(config) -> Dict[str, Any]:
+    """Serialize any (possibly nested) config dataclass to plain dicts —
+    checkpoint metadata / CLI round-trip. Records the concrete class name
+    under ``"_type"`` so nested generic fields rebuild correctly."""
+    if dataclasses.is_dataclass(config):
+        d = {f.name: config_to_dict(getattr(config, f.name)) for f in fields(config)}
+        d["_type"] = type(config).__name__
+        return d
+    if isinstance(config, (list, tuple)):
+        return [config_to_dict(v) for v in config]
+    return config
+
+
+def config_from_dict(cls, d: Dict[str, Any]):
+    """Rebuild a config dataclass from :func:`config_to_dict` output.
+
+    ``cls`` is the expected (base) class; an embedded ``"_type"`` naming a
+    registered subclass takes precedence.
+    """
+    target = _CONFIG_REGISTRY.get(d.get("_type", ""), cls)
+    if target is None:
+        raise ValueError(f"unknown config type {d.get('_type')!r} (not registered)")
+    kwargs = {}
+    for f in fields(target):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        if isinstance(v, dict) and "_type" in v:
+            kwargs[f.name] = config_from_dict(None, v)
+        else:
+            kwargs[f.name] = tuple(v) if isinstance(v, list) else v
+    return target(**kwargs)

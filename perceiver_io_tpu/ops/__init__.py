@@ -1,0 +1,7 @@
+from perceiver_io_tpu.ops.position import (
+    FourierPositionEncoding,
+    RotaryEmbedding,
+    frequency_position_encoding,
+    positions,
+)
+from perceiver_io_tpu.ops.attention import dot_product_attention

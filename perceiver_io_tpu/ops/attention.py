@@ -1,0 +1,131 @@
+"""Scaled dot-product attention — the single attention primitive shared by all
+Perceiver cross-/self-attention modules.
+
+Capability parity with reference ``perceiver/model/core/modules.py:84-154``:
+optional causal masking of right-aligned q/kv of unequal length, boolean key
+pad masking, attention-matrix dropout, and a ``max_heads_parallel`` knob that
+bounds peak memory by serializing over head groups.
+
+TPU-first design notes:
+- logits/softmax always computed in float32 regardless of input dtype
+  (bf16 q/k/v stay bf16 for the matmuls feeding the MXU; the softmax runs on
+  the VPU in fp32 for numerical parity with the reference).
+- masks are applied as ``where(mask, -inf_min, logits)`` selects on the fp32
+  logits; XLA fuses them into the softmax.
+- ``impl='flash'`` dispatches to the Pallas flash kernel
+  (:mod:`perceiver_io_tpu.ops.flash_attention`) when shapes permit;
+  ``impl='xla'`` is the reference-semantics einsum path. ``'auto'`` picks
+  flash on TPU for long sequences.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_value() -> float:
+    return float(jnp.finfo(jnp.float32).min)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    pad_mask: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    max_heads_parallel: Optional[int] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Attention over pre-projected (and pre-scaled, pre-rotated) heads.
+
+    :param q: ``(b, h, i, ck)`` queries — already multiplied by ``ck**-0.5``
+        and rotary-rotated by the caller (mirroring the reference's order of
+        operations, ``modules.py:104-115``).
+    :param k: ``(b, h, j, ck)`` keys (rotary-rotated by caller).
+    :param v: ``(b, h, j, cv)`` values.
+    :param pad_mask: optional boolean ``(b, j)``; **True marks padding** (the
+        reference's convention, ``modules.py:97``).
+    :param causal: apply right-aligned causal masking.
+    :param dropout_rate: dropout on the post-softmax attention matrix.
+    :param max_heads_parallel: process at most this many heads at once
+        (memory bound); ``None`` = all heads.
+    :param impl: ``'auto' | 'xla' | 'flash'``.
+    :return: ``(b, h, i, cv)``.
+    """
+    use_flash = False
+    if impl == "flash" or (impl == "auto" and _flash_eligible(q, k, v, dropout_rate)):
+        from perceiver_io_tpu.ops import flash_attention
+
+        if impl == "flash" and dropout_rate > 0.0:
+            raise ValueError("flash attention does not support attention dropout")
+        use_flash = flash_attention.supported(q, k, v, causal=causal)
+        if impl == "flash" and not use_flash:
+            raise ValueError(
+                f"flash attention requested but unsupported for shapes q={q.shape} k={k.shape}"
+            )
+    if use_flash:
+        from perceiver_io_tpu.ops import flash_attention
+
+        return flash_attention.flash_attention(q, k, v, pad_mask=pad_mask, causal=causal)
+
+    num_heads = q.shape[1]
+    if max_heads_parallel is None or max_heads_parallel >= num_heads:
+        return _attention_xla(q, k, v, pad_mask, causal, dropout_rate, dropout_rng)
+
+    chunks = []
+    for h0 in range(0, num_heads, max_heads_parallel):
+        h1 = min(h0 + max_heads_parallel, num_heads)
+        rng = None
+        if dropout_rng is not None:
+            dropout_rng, rng = jax.random.split(dropout_rng)
+        chunks.append(
+            _attention_xla(
+                q[:, h0:h1], k[:, h0:h1], v[:, h0:h1], pad_mask, causal, dropout_rate, rng
+            )
+        )
+    return jnp.concatenate(chunks, axis=1)
+
+
+def _flash_eligible(q, k, v, dropout_rate) -> bool:
+    # Flash path only on TPU, without attention dropout (the reference default
+    # is dropout 0.0 everywhere; training configs that enable it fall back).
+    if dropout_rate > 0.0:
+        return False
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+def _attention_xla(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    pad_mask: Optional[jnp.ndarray],
+    causal: bool,
+    dropout_rate: float,
+    dropout_rng: Optional[jax.Array],
+) -> jnp.ndarray:
+    i, j = q.shape[-2], k.shape[-2]
+    logits = jnp.einsum("bhic,bhjc->bhij", q, k, preferred_element_type=jnp.float32)
+    logits = logits.astype(jnp.float32)
+
+    if pad_mask is not None:
+        logits = jnp.where(pad_mask[:, None, None, :], _mask_value(), logits)
+    if causal:
+        allowed = jnp.arange(j)[None, :] <= jnp.arange(i)[:, None] + (j - i)
+        logits = jnp.where(allowed[None, None], logits, _mask_value())
+
+    attn = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, attn.shape)
+        attn = jnp.where(keep, attn / (1.0 - dropout_rate), 0.0)
+    attn = attn.astype(v.dtype)
+    return jnp.einsum("bhij,bhjc->bhic", attn, v)

@@ -1,0 +1,26 @@
+"""Pallas TPU flash attention kernels (filled in by the perf pass).
+
+Until the kernels land, :func:`supported` returns False so
+:func:`perceiver_io_tpu.ops.attention.dot_product_attention` always takes the
+XLA einsum path.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def supported(q, k, v, *, causal: bool) -> bool:
+    return False
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    pad_mask: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+) -> jnp.ndarray:
+    raise NotImplementedError("Pallas flash attention not yet implemented")

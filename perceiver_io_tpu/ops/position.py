@@ -1,0 +1,132 @@
+"""Position encodings: shifted absolute positions, rotary embeddings,
+inverse-frequency encodings and N-D Fourier features.
+
+Capability parity with reference ``perceiver/model/core/position.py:9-138``;
+implemented as pure functions / pytree dataclasses so everything is traceable
+and shardable under ``jit``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+def positions(b: int, n: int, shift: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Absolute positions ``0..n-1`` per batch row, optionally shifted left by a
+    per-row pad count (for left-padded batches) and clamped at 0.
+
+    Mirrors reference ``position.py:9-17``.
+
+    :param shift: optional ``(b, 1)`` int array — number of left-pad tokens.
+    :return: ``(b, n)`` int32 positions.
+    """
+    pos = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (b, n))
+    if shift is not None:
+        if shift.shape != (b, 1):
+            raise ValueError(f"shift must have shape {(b, 1)} but has shape {shift.shape}")
+        pos = pos - shift.astype(jnp.int32)
+    return jnp.maximum(pos, 0)
+
+
+def frequency_position_encoding(abs_pos: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Inverse-frequency encoding of absolute positions (rotary frequencies).
+
+    ``inv_freq_i = 10000 ** (-2i/dim)``; each frequency is repeated twice along
+    the channel axis so that consecutive channel pairs share a frequency (the
+    pair layout consumed by :func:`rotate_half`). Mirrors reference
+    ``position.py:53-71``.
+
+    :param abs_pos: ``(..., n)`` integer positions.
+    :param dim: number of rotated channels (even).
+    :return: ``(..., n, dim)`` float32 angles ``pos * inv_freq``.
+    """
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    pos_enc = abs_pos.astype(jnp.float32)[..., None] * inv_freq
+    # [f0, f0, f1, f1, ...] pairing, matching the reference's (pf r) repeat.
+    return jnp.repeat(pos_enc, 2, axis=-1)
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    """Channel-pair rotation ``[x1, x2, x3, x4, ...] -> [-x2, x1, -x4, x3, ...]``."""
+    x = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    x1, x2 = x[..., 0], x[..., 1]
+    x = jnp.stack((-x2, x1), axis=-1)
+    return x.reshape(*x.shape[:-2], -1)
+
+
+@struct.dataclass
+class RotaryEmbedding:
+    """Rotary position embedding (RoFormer) applied to the leading
+    ``rotate_dim`` channels of q/k heads; remaining channels pass through.
+
+    ``frq_pos_enc`` has shape ``(b, n, rotate_dim)``. When ``right_align`` is
+    set, a shorter input of length ``m < n`` is aligned to the *last* ``m``
+    positions — used by Perceiver AR where latents sit at the sequence tail.
+    Mirrors reference ``position.py:20-50``.
+    """
+
+    frq_pos_enc: jnp.ndarray
+    right_align: bool = struct.field(pytree_node=False, default=False)
+
+    @property
+    def rotate_dim(self) -> int:
+        return self.frq_pos_enc.shape[-1]
+
+    def rotate(self, t: jnp.ndarray) -> jnp.ndarray:
+        """Rotate ``t`` of shape ``(b, h, m, c)`` with ``c >= rotate_dim``."""
+        seq_len = t.shape[-2]
+        pos_enc = self.frq_pos_enc[:, None, :, :]  # (b, 1, n, rd)
+        if self.right_align:
+            pos_enc = pos_enc[..., pos_enc.shape[-2] - seq_len :, :]
+        else:
+            pos_enc = pos_enc[..., :seq_len, :]
+        pos_enc = pos_enc.astype(jnp.float32)
+        t_rot, t_pass = t[..., : self.rotate_dim], t[..., self.rotate_dim :]
+        t_dtype = t_rot.dtype
+        t_rot = t_rot.astype(jnp.float32)
+        t_rot = t_rot * jnp.cos(pos_enc) + rotate_half(t_rot) * jnp.sin(pos_enc)
+        return jnp.concatenate((t_rot.astype(t_dtype), t_pass), axis=-1)
+
+
+class FourierPositionEncoding:
+    """N-D Fourier feature position encoding for grid-shaped inputs (images).
+
+    Positions are evenly spaced in ``[-1, 1]`` per spatial dim (``ij`` indexed
+    meshgrid, matching reference ``position.py:91-99``); each coordinate is
+    expanded with ``num_frequency_bands`` sin/cos features with frequencies
+    linearly spaced in ``[1, max_freq/2]`` plus the raw coordinate.
+
+    The encoding is input-independent, so it is precomputed once with NumPy at
+    construction and becomes an XLA constant when used under ``jit``.
+    """
+
+    def __init__(self, input_shape: Sequence[int], num_frequency_bands: int):
+        self.input_shape = tuple(input_shape)
+        self.num_frequency_bands = num_frequency_bands
+        self._encoding = self._build()  # (prod(input_shape), C) float32
+
+    def _build(self) -> np.ndarray:
+        coords = [np.linspace(-1.0, 1.0, num=s, dtype=np.float32) for s in self.input_shape]
+        pos = np.stack(np.meshgrid(*coords, indexing="ij"), axis=-1)  # (*shape, d)
+        encodings = [pos]
+        grids = []
+        for i, max_freq in enumerate(self.input_shape):
+            freqs = np.linspace(1.0, max_freq / 2.0, num=self.num_frequency_bands, dtype=np.float32)
+            grids.append(pos[..., i : i + 1] * freqs)
+        encodings.extend([np.sin(math.pi * g) for g in grids])
+        encodings.extend([np.cos(math.pi * g) for g in grids])
+        enc = np.concatenate(encodings, axis=-1)
+        return enc.reshape(-1, enc.shape[-1])
+
+    @property
+    def num_channels(self) -> int:
+        return len(self.input_shape) * (2 * self.num_frequency_bands + 1)
+
+    def __call__(self, b: int) -> jnp.ndarray:
+        """Return ``(b, prod(input_shape), num_channels)`` encodings."""
+        enc = jnp.asarray(self._encoding)
+        return jnp.broadcast_to(enc, (b, *enc.shape))

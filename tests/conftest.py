@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding (dp/fsdp/tp/sp) is exercised without TPU hardware — the simulation
+strategy SURVEY.md §4 calls for (the reference has no distributed tests at
+all).
+
+The environment's sitecustomize force-registers the axon TPU plugin and
+overrides ``JAX_PLATFORMS``, so we must re-force CPU via ``jax.config``
+*after* importing jax but before the first operation.
+"""
+import os
+
+# Must be set before the jax backend initializes.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    ds = jax.devices()
+    assert len(ds) == 8, f"expected 8 virtual CPU devices, got {ds}"
+    return ds

@@ -1,0 +1,310 @@
+"""Tests for the core Perceiver runtime modules: shapes, weight-sharing rules,
+prefix dropout static shapes, masking behavior, remat equivalence."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.core import (
+    ClassificationOutputAdapter,
+    PerceiverAR,
+    PerceiverDecoder,
+    PerceiverEncoder,
+    PerceiverIO,
+    TrainableQueryProvider,
+)
+from perceiver_io_tpu.models.core.adapter import InputAdapter
+from perceiver_io_tpu.ops.position import frequency_position_encoding
+
+
+class DenseAdapter(InputAdapter):
+    channels: int = 32
+
+    @property
+    def num_input_channels(self):
+        return self.channels
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.channels, name="proj")(x)
+
+
+class TokenAdapter(InputAdapter):
+    """Minimal RotarySupport-style adapter: returns (embeddings, rotary freqs)."""
+
+    vocab: int = 32
+    channels: int = 16
+    rotated_channels_per_head: int = 8
+
+    @property
+    def num_input_channels(self):
+        return self.channels
+
+    @nn.compact
+    def __call__(self, x, abs_pos=None):
+        emb = nn.Embed(self.vocab, self.channels, name="embed")(x)
+        frq = frequency_position_encoding(abs_pos, self.rotated_channels_per_head)
+        return emb, frq
+
+
+def param_count(params):
+    return sum(np.prod(p.shape) for p in jax.tree.leaves(params))
+
+
+def make_encoder(**kwargs):
+    defaults = dict(
+        input_adapter=DenseAdapter(),
+        num_latents=8,
+        num_latent_channels=16,
+        num_cross_attention_heads=2,
+        num_self_attention_heads=2,
+        num_self_attention_layers_per_block=2,
+    )
+    defaults.update(kwargs)
+    return PerceiverEncoder(**defaults)
+
+
+class TestEncoder:
+    def test_forward_shape(self):
+        enc = make_encoder()
+        x = jnp.ones((2, 10, 4))
+        v = enc.init(jax.random.PRNGKey(0), x)
+        out = enc.apply(v, x)
+        assert out.shape == (2, 8, 16)
+
+    def test_return_adapted_input(self):
+        enc = make_encoder()
+        x = jnp.ones((2, 10, 4))
+        v = enc.init(jax.random.PRNGKey(0), x)
+        lat, adapted = enc.apply(v, x, return_adapted_input=True)
+        assert lat.shape == (2, 8, 16)
+        assert adapted.shape == (2, 10, 32)
+
+    def test_config_validation(self):
+        x = jnp.ones((2, 10, 4))
+        with pytest.raises(ValueError):
+            make_encoder(num_cross_attention_layers=0).init(jax.random.PRNGKey(0), x)
+        with pytest.raises(ValueError):
+            # more cross-attention layers than self-attention blocks
+            make_encoder(num_cross_attention_layers=3, num_self_attention_blocks=2).init(
+                jax.random.PRNGKey(0), x
+            )
+
+    def test_weight_sharing_rules(self):
+        """Shared configs must not allocate extra modules; unshared must.
+        Mirrors reference sharing properties (modules.py:485-491)."""
+        x = jnp.ones((1, 10, 4))
+        key = jax.random.PRNGKey(0)
+
+        shared = make_encoder(
+            num_cross_attention_layers=2,
+            num_self_attention_blocks=2,
+            first_cross_attention_layer_shared=True,
+            first_self_attention_block_shared=True,
+        )
+        vs = shared.init(key, x)
+        assert "cross_attn_n" not in vs["params"]
+        assert "self_attn_n" not in vs["params"]
+
+        unshared = make_encoder(
+            num_cross_attention_layers=2,
+            num_self_attention_blocks=2,
+            first_cross_attention_layer_shared=False,
+            first_self_attention_block_shared=False,
+        )
+        vu = unshared.init(key, x)
+        assert "cross_attn_n" in vu["params"]
+        assert "self_attn_n" in vu["params"]
+
+        # sharing changes the function: repeated application of the same
+        # weights vs distinct weights
+        out_s = shared.apply(vs, x)
+        assert out_s.shape == (1, 8, 16)
+
+    def test_pad_mask_excludes_padding(self, rng):
+        enc = make_encoder()
+        x = jnp.asarray(rng.normal(size=(1, 10, 4)), jnp.float32)
+        v = enc.init(jax.random.PRNGKey(0), x)
+        pad = jnp.zeros((1, 10), bool).at[0, 7:].set(True)
+        out1 = enc.apply(v, x, pad_mask=pad)
+        x2 = x.at[0, 7:].add(100.0)
+        out2 = enc.apply(v, x2, pad_mask=pad)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-5)
+
+    def test_remat_equivalence(self, rng):
+        x = jnp.asarray(rng.normal(size=(2, 10, 4)), jnp.float32)
+        enc = make_encoder(num_self_attention_blocks=2, num_cross_attention_layers=2)
+        v = enc.init(jax.random.PRNGKey(0), x)
+        enc_remat = make_encoder(
+            num_self_attention_blocks=2,
+            num_cross_attention_layers=2,
+            activation_checkpointing=True,
+        )
+        out = enc.apply(v, x)
+        out_remat = enc_remat.apply(v, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_remat), atol=1e-6)
+
+        # grads must also agree
+        def loss(params, module):
+            return jnp.sum(module.apply({"params": params}, x) ** 2)
+
+        g1 = jax.grad(loss)(v["params"], enc)
+        g2 = jax.grad(loss)(v["params"], enc_remat)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5), g1, g2
+        )
+
+
+class TestDecoder:
+    def test_classification_decoder(self):
+        dec = PerceiverDecoder(
+            output_adapter=ClassificationOutputAdapter(num_classes=5, num_output_query_channels=16),
+            output_query_provider=TrainableQueryProvider(num_queries=1, num_query_channels_=16),
+            num_latent_channels=16,
+            num_output_query_channels=16,
+        )
+        lat = jnp.ones((3, 8, 16))
+        v = dec.init(jax.random.PRNGKey(0), lat)
+        out = dec.apply(v, lat)
+        assert out.shape == (3, 5)
+
+    def test_adapted_input_queries(self):
+        """Decoder queries = adapted encoder input (optical-flow pattern,
+        reference backend.py:124,135-137)."""
+
+        class IdentityAdapter(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return x
+
+        dec = PerceiverDecoder(
+            output_adapter=IdentityAdapter(),
+            output_query_provider=None,
+            num_latent_channels=16,
+            num_output_query_channels=32,
+        )
+        lat = jnp.ones((2, 8, 16))
+        adapted = jnp.ones((2, 10, 32))
+        v = dec.init(jax.random.PRNGKey(0), lat, adapted)
+        out = dec.apply(v, lat, adapted)
+        assert out.shape == (2, 10, 32)
+
+    def test_non_residual_cross_attention(self, rng):
+        """cross_attention_residual=False (MLM decoder) must change output."""
+        lat = jnp.asarray(rng.normal(size=(1, 8, 16)), jnp.float32)
+
+        def build(residual):
+            return PerceiverDecoder(
+                output_adapter=ClassificationOutputAdapter(
+                    num_classes=5, num_output_query_channels=16
+                ),
+                output_query_provider=TrainableQueryProvider(
+                    num_queries=4, num_query_channels_=16
+                ),
+                num_latent_channels=16,
+                num_output_query_channels=16,
+                cross_attention_residual=residual,
+            )
+
+        d1, d2 = build(True), build(False)
+        v = d1.init(jax.random.PRNGKey(0), lat)
+        o1, o2 = d1.apply(v, lat), d2.apply(v, lat)
+        assert o1.shape == o2.shape == (1, 4, 5)
+        assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+class TestPerceiverIO:
+    def test_end_to_end(self):
+        model = PerceiverIO(
+            encoder=make_encoder(),
+            decoder=PerceiverDecoder(
+                output_adapter=ClassificationOutputAdapter(
+                    num_classes=5, num_output_query_channels=16
+                ),
+                output_query_provider=TrainableQueryProvider(num_queries=1, num_query_channels_=16),
+                num_latent_channels=16,
+                num_output_query_channels=16,
+            ),
+        )
+        x = jnp.ones((2, 10, 4))
+        v = model.init(jax.random.PRNGKey(0), x)
+        out = model.apply(v, x)
+        assert out.shape == (2, 5)
+
+
+class TestPerceiverAR:
+    def make(self, **kwargs):
+        defaults = dict(
+            input_adapter=TokenAdapter(),
+            num_heads=2,
+            num_self_attention_layers=2,
+        )
+        defaults.update(kwargs)
+        return PerceiverAR(**defaults)
+
+    def test_forward_shape(self):
+        ar = self.make()
+        ids = jnp.zeros((2, 12), jnp.int32)
+        v = ar.init(jax.random.PRNGKey(0), ids, 6)
+        out = ar.apply(v, ids, 6)
+        assert out.shape == (2, 6, 16)  # latents = 12 - 6
+
+    def test_prefix_len_validation(self):
+        ar = self.make()
+        ids = jnp.zeros((2, 12), jnp.int32)
+        v = ar.init(jax.random.PRNGKey(0), ids, 6)
+        with pytest.raises(ValueError):
+            ar.apply(v, ids, 12)
+        with pytest.raises(ValueError):
+            ar.apply(v, ids, -1)
+
+    def test_prefix_dropout_static_shape(self):
+        """Train-mode prefix dropout keeps a static number of positions and
+        still produces the full latent output."""
+        ar = self.make(cross_attention_dropout=0.5)
+        ids = jnp.zeros((2, 12), jnp.int32)
+        v = ar.init(jax.random.PRNGKey(0), ids, 6)
+        out = ar.apply(
+            v, ids, 6, None, False,
+            rngs={"prefix": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)},
+        )
+        assert out.shape == (2, 6, 16)
+
+    def test_prefix_dropout_eval_identity(self, rng):
+        """Dropout must be inactive in eval mode regardless of rate."""
+        ids = jnp.asarray(rng.integers(0, 32, (2, 12)), jnp.int32)
+        a1 = self.make(cross_attention_dropout=0.5)
+        a2 = self.make(cross_attention_dropout=0.0)
+        v = a1.init(jax.random.PRNGKey(0), ids, 6)
+        np.testing.assert_allclose(
+            np.asarray(a1.apply(v, ids, 6)), np.asarray(a2.apply(v, ids, 6)), atol=1e-6
+        )
+
+    def test_causality(self, rng):
+        """Changing token t must not affect latent outputs for positions < t."""
+        ar = self.make()
+        ids = jnp.asarray(rng.integers(0, 32, (1, 12)), jnp.int32)
+        v = ar.init(jax.random.PRNGKey(0), ids, 6)
+        out1 = ar.apply(v, ids, 6)
+        ids2 = ids.at[0, 10].set((ids[0, 10] + 1) % 32)  # latent index 4
+        out2 = ar.apply(v, ids2, 6)
+        np.testing.assert_allclose(
+            np.asarray(out1[0, :4]), np.asarray(out2[0, :4]), atol=1e-5
+        )
+        assert not np.allclose(np.asarray(out1[0, 4:]), np.asarray(out2[0, 4:]))
+
+    def test_left_pad_shift_invariance(self, rng):
+        """A left-padded sequence must produce the same latent outputs as the
+        unpadded sequence (positions are shifted by the pad count)."""
+        ar = self.make(cross_attention_dropout=0.0)
+        short = jnp.asarray(rng.integers(1, 32, (1, 10)), jnp.int32)
+        v = ar.init(jax.random.PRNGKey(0), short, 4)
+        out_short = ar.apply(v, short, 4)
+
+        padded = jnp.concatenate([jnp.zeros((1, 2), jnp.int32), short], axis=1)
+        pad_mask = jnp.zeros((1, 12), bool).at[0, :2].set(True)
+        out_padded = ar.apply(v, padded, 6, pad_mask)
+        np.testing.assert_allclose(
+            np.asarray(out_short[0, -6:]), np.asarray(out_padded[0, -6:]), atol=2e-5
+        )
