@@ -1,0 +1,8 @@
+from perceiver_io_tpu.convert.torch_import import (
+    import_causal_language_model,
+    import_image_classifier,
+    import_masked_language_model,
+    import_optical_flow,
+    import_symbolic_audio_model,
+    import_text_classifier,
+)

@@ -1,0 +1,244 @@
+"""Import reference (torch) `perceiver-io` weights into perceiver_io_tpu
+parameter pytrees.
+
+The mapping tables here are the JAX-side equivalent of the reference's
+``perceiver/model/core/huggingface.py:17-76`` copy helpers, and double as
+the numerical-equivalence test fixtures (SURVEY.md §4: logits allclose at
+atol 1e-4 is the de-facto correctness oracle).
+
+Accepted inputs are plain state-dict-like mappings ``name -> array`` (torch
+tensors or numpy arrays), so torch is only needed by the caller. Layout
+correspondences:
+
+==============================  =======================================
+reference (torch)               perceiver_io_tpu (flax)
+==============================  =======================================
+``Linear.weight`` (out, in)     ``Dense.kernel`` (in, out) — transposed
+``LayerNorm.weight``            ``LayerNorm.scale``
+``Embedding.weight``            ``Embed.embedding``
+``TrainableQueryProvider._query``  ``TrainableQueryProvider.query``
+``Sequential`` indices (0/1/3)  named modules (norm/hidden/out)
+``Residual.module`` wrapper     (transparent)
+==============================  =======================================
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _strip_wrappers(state_dict: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    """Remove fairscale checkpoint-wrapper name fragments and map the
+    reference ``PerceiverIO`` Sequential indices (``0.`` = encoder, ``1.`` =
+    decoder, reference ``modules.py:584-594``) to named prefixes."""
+    out = {}
+    for k, v in state_dict.items():
+        k = k.replace("_checkpoint_wrapped_module.", "")
+        if k.startswith("0."):
+            k = "encoder." + k[2:]
+        elif k.startswith("1."):
+            k = "decoder." + k[2:]
+        out[k] = v
+    return out
+
+
+def _linear(sd, name) -> Dict[str, np.ndarray]:
+    out = {"kernel": _np(sd[f"{name}.weight"]).T}
+    if f"{name}.bias" in sd:
+        out["bias"] = _np(sd[f"{name}.bias"])
+    return out
+
+
+def _norm(sd, name) -> Dict[str, np.ndarray]:
+    return {"scale": _np(sd[f"{name}.weight"]), "bias": _np(sd[f"{name}.bias"])}
+
+
+def _embed(sd, name) -> Dict[str, np.ndarray]:
+    return {"embedding": _np(sd[f"{name}.weight"])}
+
+
+def _attention(sd, base) -> Dict[str, Any]:
+    return {p: _linear(sd, f"{base}.{p}") for p in ("q_proj", "k_proj", "v_proj", "o_proj")}
+
+
+def _mlp(sd, base) -> Dict[str, Any]:
+    # reference MLP = Sequential(LayerNorm, Linear, GELU, Linear) → indices 0, 1, 3
+    return {
+        "norm": _norm(sd, f"{base}.0"),
+        "hidden": _linear(sd, f"{base}.1"),
+        "out": _linear(sd, f"{base}.3"),
+    }
+
+
+def _cross_attn_layer(sd, base, attention_residual: bool = True) -> Dict[str, Any]:
+    # CrossAttentionLayer = Sequential(Residual(CrossAttention) | CrossAttention, Residual(MLP))
+    pre = f"{base}.0.module" if attention_residual else f"{base}.0"
+    return {
+        "cross_attn": {
+            "q_norm": _norm(sd, f"{pre}.q_norm"),
+            "kv_norm": _norm(sd, f"{pre}.kv_norm"),
+            "attention": _attention(sd, f"{pre}.attention"),
+        },
+        "mlp": _mlp(sd, f"{base}.1.module"),
+    }
+
+
+def _self_attn_layer(sd, base) -> Dict[str, Any]:
+    return {
+        "self_attn": {
+            "norm": _norm(sd, f"{base}.0.module.norm"),
+            "attention": _attention(sd, f"{base}.0.module.attention"),
+        },
+        "mlp": _mlp(sd, f"{base}.1.module"),
+    }
+
+
+def _self_attn_block(sd, base, num_layers: int) -> Dict[str, Any]:
+    return {f"layers_{i}": _self_attn_layer(sd, f"{base}.{i}") for i in range(num_layers)}
+
+
+def _encoder(sd, base, encoder_config, prefix_sep=".") -> Dict[str, Any]:
+    """PerceiverEncoder params (without the input adapter)."""
+    c = encoder_config
+    out = {
+        "latent_provider": {"query": _np(sd[f"{base}{prefix_sep}latent_provider._query"])},
+        "cross_attn_1": _cross_attn_layer(sd, f"{base}{prefix_sep}cross_attn_1"),
+        "self_attn_1": _self_attn_block(
+            sd, f"{base}{prefix_sep}self_attn_1", c.num_self_attention_layers_per_block
+        ),
+    }
+    if c.num_cross_attention_layers > 1 and not c.first_cross_attention_layer_shared:
+        out["cross_attn_n"] = _cross_attn_layer(sd, f"{base}{prefix_sep}cross_attn_n")
+    if c.num_self_attention_blocks > 1 and not c.first_self_attention_block_shared:
+        out["self_attn_n"] = _self_attn_block(
+            sd, f"{base}{prefix_sep}self_attn_n", c.num_self_attention_layers_per_block
+        )
+    return out
+
+
+def _text_input_adapter(sd, base, abs_pos_emb: bool = True) -> Dict[str, Any]:
+    out = {"txt_embedding": _embed(sd, f"{base}.txt_embedding")}
+    if abs_pos_emb and f"{base}.pos_embedding.weight" in sd:
+        out["pos_embedding"] = _embed(sd, f"{base}.pos_embedding")
+    return out
+
+
+def _decoder(sd, base, decoder_config) -> Dict[str, Any]:
+    residual = getattr(decoder_config, "cross_attention_residual", True)
+    return {"cross_attn": _cross_attn_layer(sd, f"{base}.cross_attn", attention_residual=residual)}
+
+
+# ---------------------------------------------------------------------------
+# Task models
+# ---------------------------------------------------------------------------
+
+
+def import_masked_language_model(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Reference ``MaskedLanguageModel`` state_dict → :class:`MaskedLanguageModel`
+    params (config = :data:`MaskedLanguageModelConfig`)."""
+    sd = _strip_wrappers(state_dict)
+    params = {
+        "encoder": {
+            "input_adapter": _text_input_adapter(sd, "encoder.input_adapter"),
+            **_encoder(sd, "encoder", config.encoder),
+        },
+        "decoder": {
+            "output_query_provider": {"query": _np(sd["decoder.output_query_provider._query"])},
+            **_decoder(sd, "decoder", config.decoder),
+        },
+    }
+    if config.decoder.num_output_query_channels is None:
+        if "decoder.output_adapter.bias" in sd:
+            params["decoder"]["output_adapter"] = {"bias": _np(sd["decoder.output_adapter.bias"])}
+    else:
+        params["decoder"]["output_adapter"] = {
+            "linear": _linear(sd, "decoder.output_adapter.linear")
+        }
+    return params
+
+
+def import_text_classifier(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Reference ``TextClassifier`` state_dict → :class:`TextClassifier` params."""
+    sd = _strip_wrappers(state_dict)
+    return {
+        "encoder": {
+            "input_adapter": _text_input_adapter(sd, "encoder.input_adapter"),
+            **_encoder(sd, "encoder", config.encoder),
+        },
+        "decoder": {
+            "output_query_provider": {"query": _np(sd["decoder.output_query_provider._query"])},
+            "output_adapter": {"linear": _linear(sd, "decoder.output_adapter.linear")},
+            **_decoder(sd, "decoder", config.decoder),
+        },
+    }
+
+
+def import_image_classifier(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Reference ``ImageClassifier`` state_dict → :class:`ImageClassifier` params
+    (the image input adapter holds no parameters — Fourier features are
+    deterministic)."""
+    sd = _strip_wrappers(state_dict)
+    return {
+        "encoder": _encoder(sd, "encoder", config.encoder),
+        "decoder": {
+            "output_query_provider": {"query": _np(sd["decoder.output_query_provider._query"])},
+            "output_adapter": {"linear": _linear(sd, "decoder.output_adapter.linear")},
+            **_decoder(sd, "decoder", config.decoder),
+        },
+    }
+
+
+def import_optical_flow(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Reference ``OpticalFlow`` state_dict → :class:`OpticalFlow` params."""
+    sd = _strip_wrappers(state_dict)
+    return {
+        "encoder": {
+            "input_adapter": {"linear": _linear(sd, "encoder.input_adapter.linear")},
+            **_encoder(sd, "encoder", config.encoder),
+        },
+        "decoder": {
+            "output_adapter": {"linear": _linear(sd, "decoder.output_adapter.linear")},
+            **_decoder(sd, "decoder", config.decoder),
+        },
+    }
+
+
+def _sequence_model(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Shared CLM / symbolic-audio import: reference flat PerceiverAR layout →
+    our ``perceiver_ar``-nested layout."""
+    sd = _strip_wrappers(state_dict)
+    params: Dict[str, Any] = {
+        "perceiver_ar": {
+            "input_adapter": _text_input_adapter(
+                sd, "input_adapter", abs_pos_emb=config.abs_pos_emb
+            ),
+            "cross_attention": _cross_attn_layer(sd, "cross_attention"),
+            "self_attention": _self_attn_block(
+                sd, "self_attention", config.num_self_attention_layers
+            ),
+        }
+    }
+    if config.output_norm:
+        params["out_norm"] = _norm(sd, "out_norm")
+    if config.output_bias:
+        params["output_adapter"] = {"bias": _np(sd["output_adapter.bias"])}
+    return params
+
+
+def import_causal_language_model(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Reference ``CausalLanguageModel`` state_dict → :class:`CausalLanguageModel`
+    params (config = :class:`CausalLanguageModelConfig`)."""
+    return _sequence_model(state_dict, config)
+
+
+def import_symbolic_audio_model(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Reference ``SymbolicAudioModel`` state_dict → :class:`SymbolicAudioModel`
+    params."""
+    return _sequence_model(state_dict, config)

@@ -1,0 +1,24 @@
+"""Symbolic audio model (Perceiver AR over MIDI event tokens) — reference
+``perceiver/model/audio/symbolic/backend.py``. Same backbone as the text CLM
+(the shared :class:`AutoregressiveSequenceModel`), 389-token event vocab."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from perceiver_io_tpu.models.core.config import register_config
+from perceiver_io_tpu.models.sequence import AutoregressiveSequenceModel, SequenceModelConfig
+
+
+@register_config
+@dataclass
+class SymbolicAudioModelConfig(SequenceModelConfig):
+    """Defaults per reference ``symbolic/backend.py:10-23``."""
+
+    vocab_size: int = 389
+    max_seq_len: int = 4096
+    max_latents: int = 1024
+    num_channels: int = 512
+
+
+class SymbolicAudioModel(AutoregressiveSequenceModel):
+    """Reference ``symbolic/backend.py:93-143``."""

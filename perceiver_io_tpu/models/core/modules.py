@@ -47,7 +47,8 @@ def _dense(features: int, use_bias: bool, init_scale: float, dtype, name: str) -
 
 
 def _layer_norm(dtype, name: str) -> nn.LayerNorm:
-    return nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=dtype, name=name)
+    # use_fast_variance=False: two-pass variance matches torch numerically
+    return nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=dtype, name=name, use_fast_variance=False)
 
 
 class MultiHeadAttention(nn.Module):
@@ -443,7 +444,15 @@ class SelfAttentionLayer(nn.Module):
 class SelfAttentionBlock(nn.Module):
     """Stack of self-attention layers; ``activation_checkpointing`` remats
     each layer (fairscale ``checkpoint_wrapper`` equivalent, reference
-    ``modules.py:310-350``)."""
+    ``modules.py:310-350``).
+
+    ``rotary_all_layers=False`` replicates a load-bearing reference behavior:
+    its custom ``Sequential`` forwards kwargs only to the *first* submodule
+    (reference ``utils.py:4-14``), so rotary embeddings reach only the first
+    self-attention layer of a block — Perceiver AR checkpoints are trained
+    with that semantics. Set True for rotary at every layer. ``pad_mask`` is
+    always forwarded to every layer (no reference call site passes one to a
+    block, so parity is unaffected)."""
 
     num_layers: int
     num_heads: int
@@ -456,6 +465,7 @@ class SelfAttentionBlock(nn.Module):
     dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    rotary_all_layers: bool = False
     qkv_bias: bool = True
     out_bias: bool = True
     mlp_bias: bool = True
@@ -497,8 +507,9 @@ class SelfAttentionBlock(nn.Module):
         rot_pos_emb: Optional[RotaryEmbedding] = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
-        for layer in self.layers:
-            x = layer(x, pad_mask, rot_pos_emb, deterministic)
+        for i, layer in enumerate(self.layers):
+            rot = rot_pos_emb if (i == 0 or self.rotary_all_layers) else None
+            x = layer(x, pad_mask, rot, deterministic)
         return x
 
 

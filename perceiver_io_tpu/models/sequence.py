@@ -1,0 +1,187 @@
+"""Generic autoregressive sequence model over a token vocabulary — the shared
+backbone of the text CLM (reference ``perceiver/model/text/clm/backend.py``)
+and the symbolic audio model (``perceiver/model/audio/symbolic/backend.py``),
+which are the same model with different config defaults (the reference
+acknowledges the duplication with TODOs, ``symbolic/backend.py:26,55,92``;
+here it is factored properly).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import InputAdapter
+from perceiver_io_tpu.models.core.config import PerceiverARConfig, register_config
+from perceiver_io_tpu.models.core.modules import LAYER_NORM_EPS, PerceiverAR
+from perceiver_io_tpu.ops.position import frequency_position_encoding, positions
+
+
+@register_config
+@dataclass
+class SequenceModelConfig(PerceiverARConfig):
+    """Hyperparameters shared by CLM and symbolic audio (reference
+    ``clm/backend.py:11-24`` / ``symbolic/backend.py:10-23``)."""
+
+    vocab_size: int = 262
+    max_seq_len: int = 4096
+    max_latents: int = 512
+    num_channels: int = 512
+    output_norm: bool = False
+    output_bias: bool = True
+    abs_pos_emb: bool = True
+    init_scale: float = 0.02
+
+    @classmethod
+    def create(cls, **kwargs):
+        return cls(**{f.name: kwargs[f.name] for f in fields(cls) if f.name in kwargs})
+
+    @property
+    def max_prefix_len(self) -> int:
+        return self.max_seq_len - self.max_latents
+
+    @property
+    def rotated_channels_per_head(self) -> int:
+        """Rotary on 100% of head channels, or 50% when an absolute position
+        embedding is also used (reference ``clm/backend.py:59-63``)."""
+        n = self.num_channels // self.num_heads
+        return n // 2 if self.abs_pos_emb else n
+
+
+class SequenceInputAdapter(InputAdapter):
+    """Token embedding + optional learned absolute position embedding, plus
+    rotary frequency encodings (the RotarySupport contract) — reference
+    ``text/common/backend.py:20-45`` + ``core/adapter.py:22-32``."""
+
+    vocab_size: int
+    max_seq_len: int
+    num_channels: int
+    rotated_channels_per_head: int
+    abs_pos_emb: bool = True
+    init_scale: float = 0.02
+    dtype: Any = jnp.float32
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_channels
+
+    def setup(self):
+        self.txt_embedding = nn.Embed(
+            self.vocab_size,
+            self.num_channels,
+            embedding_init=nn.initializers.normal(stddev=self.init_scale),
+            name="txt_embedding",
+        )
+        if self.abs_pos_emb:
+            self.pos_embedding = nn.Embed(
+                self.max_seq_len,
+                self.num_channels,
+                embedding_init=nn.initializers.normal(stddev=self.init_scale),
+                name="pos_embedding",
+            )
+
+    def __call__(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None):
+        if abs_pos is None:
+            abs_pos = positions(*x.shape)
+        emb = self.txt_embedding(x)
+        if self.abs_pos_emb:
+            emb = emb + self.pos_embedding(abs_pos)
+        frq = frequency_position_encoding(abs_pos, self.rotated_channels_per_head)
+        return emb.astype(self.dtype), frq
+
+    @property
+    def embeddings(self) -> jnp.ndarray:
+        """(vocab, channels) embedding table, for tied output projection."""
+        return self.txt_embedding.embedding
+
+
+class TiedOutputAdapter(nn.Module):
+    """Logits = x · Eᵀ (+ bias): weight-tied output head (reference
+    ``text/common/backend.py:48-60``)."""
+
+    vocab_size: int
+    emb_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, txt_embedding: jnp.ndarray) -> jnp.ndarray:
+        logits = x @ txt_embedding.astype(self.dtype).T
+        if self.emb_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.vocab_size,))
+            logits = logits + bias.astype(self.dtype)
+        return logits
+
+
+class AutoregressiveSequenceModel(nn.Module):
+    """Perceiver AR over a token vocabulary with tied input/output embeddings
+    (reference ``clm/backend.py:57-107`` / ``symbolic/backend.py:93-143``)."""
+
+    config: SequenceModelConfig
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.config.max_seq_len
+
+    @property
+    def max_latents(self) -> int:
+        return self.config.max_latents
+
+    @property
+    def max_prefix_len(self) -> int:
+        return self.config.max_prefix_len
+
+    def setup(self):
+        cfg = self.config
+        adapter = SequenceInputAdapter(
+            vocab_size=cfg.vocab_size,
+            max_seq_len=cfg.max_seq_len,
+            num_channels=cfg.num_channels,
+            rotated_channels_per_head=cfg.rotated_channels_per_head,
+            abs_pos_emb=cfg.abs_pos_emb,
+            init_scale=cfg.init_scale,
+            dtype=self.dtype,
+        )
+        self.perceiver_ar = PerceiverAR(
+            input_adapter=adapter,
+            init_scale=cfg.init_scale,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="perceiver_ar",
+            **cfg.base_kwargs(exclude=("activation_offloading",)),
+        )
+        if cfg.output_norm:
+            self.out_norm = nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=self.dtype, name="out_norm", use_fast_variance=False)
+        self.output_adapter = TiedOutputAdapter(
+            vocab_size=cfg.vocab_size,
+            emb_bias=cfg.output_bias,
+            dtype=self.dtype,
+            name="output_adapter",
+        )
+
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        prefix_len: int,
+        pad_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """:return: ``(b, n - prefix_len, vocab_size)`` logits for the latent
+        positions (next-token predictions)."""
+        if x.shape[1] > self.max_seq_len:
+            # Explicit guard: nn.Embed clamps out-of-range position indices
+            # silently (the torch reference raises IndexError instead).
+            raise ValueError(
+                f"sequence length ({x.shape[1]}) exceeds max_seq_len ({self.max_seq_len})"
+            )
+        if prefix_len > self.max_prefix_len:
+            raise ValueError(
+                f"prefix_len ({prefix_len}) exceeds max_prefix_len ({self.max_prefix_len})"
+            )
+        x_latent = self.perceiver_ar(x, prefix_len, pad_mask, deterministic)
+        if self.config.output_norm:
+            x_latent = self.out_norm(x_latent)
+        return self.output_adapter(x_latent, self.perceiver_ar.input_adapter.embeddings)

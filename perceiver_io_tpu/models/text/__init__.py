@@ -1,0 +1,4 @@
+from perceiver_io_tpu.models.text.common import TextEncoderConfig, TextInputAdapter, make_text_encoder
+from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel, MaskedLanguageModelConfig, TextDecoderConfig
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifierConfig

@@ -1,0 +1,70 @@
+"""Text classifier (Perceiver IO): text encoder + single-query classification
+decoder — reference ``perceiver/model/text/classifier/backend.py``.
+
+Two-stage training (load a pretrained MLM encoder, optionally freeze it) is
+handled by the trainer: ``TextEncoderConfig.params`` names the checkpoint and
+``TextEncoderConfig.freeze`` produces an optimizer mask (see
+``perceiver_io_tpu.training``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import ClassificationOutputAdapter, TrainableQueryProvider
+from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder
+from perceiver_io_tpu.models.text.common import TextEncoderConfig, make_text_encoder
+
+TextClassifierConfig = PerceiverIOConfig[TextEncoderConfig, ClassificationDecoderConfig]
+
+
+class TextClassifier(nn.Module):
+    """Reference ``classifier/backend.py:15-43``."""
+
+    config: TextClassifierConfig
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = make_text_encoder(
+            cfg.encoder,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="encoder",
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=ClassificationOutputAdapter(
+                num_classes=cfg.decoder.num_classes,
+                num_output_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            output_query_provider=TrainableQueryProvider(
+                num_queries=cfg.decoder.num_output_queries,
+                num_query_channels_=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            num_output_query_channels=cfg.decoder.num_output_query_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        pad_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        x_latent = self.encoder(x, pad_mask=pad_mask, deterministic=deterministic)
+        return self.decoder(x_latent, deterministic=deterministic)

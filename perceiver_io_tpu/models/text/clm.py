@@ -1,0 +1,24 @@
+"""Causal language model (Perceiver AR) — reference
+``perceiver/model/text/clm/backend.py``. A thin specialization of the shared
+autoregressive sequence model (UTF-8 bytes vocab 262, 4096 ctx, 512 latents)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from perceiver_io_tpu.models.core.config import register_config
+from perceiver_io_tpu.models.sequence import AutoregressiveSequenceModel, SequenceModelConfig
+
+
+@register_config
+@dataclass
+class CausalLanguageModelConfig(SequenceModelConfig):
+    """Defaults per reference ``clm/backend.py:11-24``."""
+
+    vocab_size: int = 262
+    max_seq_len: int = 4096
+    max_latents: int = 512
+    num_channels: int = 512
+
+
+class CausalLanguageModel(AutoregressiveSequenceModel):
+    """Reference ``clm/backend.py:57-107``."""

@@ -1,0 +1,109 @@
+"""Shared text components: encoder config, token input adapter, and the text
+Perceiver IO encoder builder (reference ``perceiver/model/text/common/backend.py``)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import InputAdapter
+from perceiver_io_tpu.models.core.config import EncoderConfig, register_config
+from perceiver_io_tpu.models.core.modules import PerceiverEncoder
+from perceiver_io_tpu.ops.position import positions
+
+
+@register_config
+@dataclass
+class TextEncoderConfig(EncoderConfig):
+    """Reference ``text/common/backend.py:12-17``. ``params`` points at a
+    checkpoint to warm-start the encoder from (e.g. a pretrained MLM)."""
+
+    vocab_size: int = 10003
+    max_seq_len: int = 256
+    num_input_channels: int = 64
+    params: Optional[str] = None
+
+
+class TextInputAdapter(InputAdapter):
+    """Token embedding + learned absolute position embedding (reference
+    ``text/common/backend.py:20-45``). Unlike :class:`SequenceInputAdapter`
+    this is for (non-rotary) Perceiver IO encoders and returns only the
+    embedded input."""
+
+    vocab_size: int
+    max_seq_len: int
+    num_channels: int
+    abs_pos_emb: bool = True
+    init_scale: float = 0.02
+    dtype: Any = jnp.float32
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_channels
+
+    def setup(self):
+        self.txt_embedding = nn.Embed(
+            self.vocab_size,
+            self.num_channels,
+            embedding_init=nn.initializers.normal(stddev=self.init_scale),
+            name="txt_embedding",
+        )
+        if self.abs_pos_emb:
+            self.pos_embedding = nn.Embed(
+                self.max_seq_len,
+                self.num_channels,
+                embedding_init=nn.initializers.normal(stddev=self.init_scale),
+                name="pos_embedding",
+            )
+
+    def __call__(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        if x.shape[1] > self.max_seq_len:
+            # nn.Embed clamps out-of-range position indices silently; the
+            # torch reference raises IndexError. Fail loudly instead.
+            raise ValueError(
+                f"sequence length ({x.shape[1]}) exceeds max_seq_len ({self.max_seq_len})"
+            )
+        emb = self.txt_embedding(x)
+        if self.abs_pos_emb:
+            if abs_pos is None:
+                abs_pos = positions(*x.shape)
+            emb = emb + self.pos_embedding(abs_pos)
+        return emb.astype(self.dtype)
+
+    @property
+    def embeddings(self) -> jnp.ndarray:
+        return self.txt_embedding.embedding
+
+
+def make_text_encoder(
+    config: TextEncoderConfig,
+    num_latents: int,
+    num_latent_channels: int,
+    activation_checkpointing: bool = False,
+    dtype: Any = jnp.float32,
+    attention_impl: str = "auto",
+    name: str = "encoder",
+) -> PerceiverEncoder:
+    """Build the text Perceiver IO encoder (reference
+    ``text/common/backend.py:63-88``). Freezing (``config.freeze``) is applied
+    at the optimizer level (see ``perceiver_io_tpu.training.optim.freeze_mask``),
+    not by mutating the module."""
+    input_adapter = TextInputAdapter(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_seq_len,
+        num_channels=config.num_input_channels,
+        init_scale=config.init_scale,
+        dtype=dtype,
+    )
+    return PerceiverEncoder(
+        input_adapter=input_adapter,
+        num_latents=num_latents,
+        num_latent_channels=num_latent_channels,
+        activation_checkpointing=activation_checkpointing,
+        dtype=dtype,
+        attention_impl=attention_impl,
+        name=name,
+        **config.base_kwargs(),
+    )

@@ -1,0 +1,120 @@
+"""Masked language model (Perceiver IO) — reference
+``perceiver/model/text/mlm/backend.py``."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.config import DecoderConfig, PerceiverIOConfig, register_config
+from perceiver_io_tpu.models.core.adapter import TrainableQueryProvider
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder
+from perceiver_io_tpu.models.sequence import TiedOutputAdapter
+from perceiver_io_tpu.models.text.common import TextEncoderConfig, make_text_encoder
+
+
+@register_config
+@dataclass
+class TextDecoderConfig(DecoderConfig):
+    """Reference ``mlm/backend.py:17-21``. ``num_output_query_channels=None``
+    selects the weight-tied output adapter."""
+
+    num_output_query_channels: Optional[int] = None
+    vocab_size: int = 10003
+    max_seq_len: int = 512
+
+
+MaskedLanguageModelConfig = PerceiverIOConfig[TextEncoderConfig, TextDecoderConfig]
+
+
+class UntiedTextOutputAdapter(nn.Module):
+    """Linear vocab projection (untied path, reference ``mlm/backend.py:27-33``)."""
+
+    vocab_size: int
+    num_output_query_channels: int
+    init_scale: float = 0.02
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(
+            self.vocab_size,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="linear",
+        )(x)
+
+
+class MaskedLanguageModel(nn.Module):
+    """Text encoder + decoder with ``max_seq_len`` trainable output queries;
+    logits truncated to the input length (reference ``mlm/backend.py:36-84``)."""
+
+    config: MaskedLanguageModelConfig
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    @property
+    def tied(self) -> bool:
+        return self.config.decoder.num_output_query_channels is None
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = make_text_encoder(
+            cfg.encoder,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="encoder",
+        )
+        if self.tied:
+            num_query_channels = cfg.encoder.num_input_channels
+            output_adapter = TiedOutputAdapter(
+                vocab_size=cfg.decoder.vocab_size, dtype=self.dtype
+            )
+        else:
+            num_query_channels = cfg.decoder.num_output_query_channels
+            output_adapter = UntiedTextOutputAdapter(
+                vocab_size=cfg.decoder.vocab_size,
+                num_output_query_channels=num_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            )
+        self.decoder = PerceiverDecoder(
+            output_adapter=output_adapter,
+            output_query_provider=TrainableQueryProvider(
+                num_queries=cfg.decoder.max_seq_len,
+                num_query_channels_=num_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            num_output_query_channels=num_query_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(
+        self,
+        x_masked: jnp.ndarray,
+        pad_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        _, n = x_masked.shape
+        x_latent = self.encoder(x_masked, pad_mask=pad_mask, deterministic=deterministic)
+        if self.tied:
+            logits = self.decoder(
+                x_latent,
+                deterministic=deterministic,
+                txt_embedding=self.encoder.input_adapter.embeddings,
+            )
+        else:
+            logits = self.decoder(x_latent, deterministic=deterministic)
+        return logits[:, :n, :]

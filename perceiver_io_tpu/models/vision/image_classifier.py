@@ -1,0 +1,119 @@
+"""Image classifier (Perceiver IO) with Fourier-feature position encodings —
+reference ``perceiver/model/vision/image_classifier/backend.py``."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import (
+    ClassificationOutputAdapter,
+    InputAdapter,
+    TrainableQueryProvider,
+)
+from perceiver_io_tpu.models.core.config import (
+    ClassificationDecoderConfig,
+    EncoderConfig,
+    PerceiverIOConfig,
+    register_config,
+)
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder, PerceiverEncoder
+from perceiver_io_tpu.ops.position import FourierPositionEncoding
+
+
+@register_config
+@dataclass
+class ImageEncoderConfig(EncoderConfig):
+    """Reference ``image_classifier/backend.py:21-25``."""
+
+    image_shape: Tuple[int, int, int] = (224, 224, 3)
+    num_frequency_bands: int = 32
+
+
+ImageClassifierConfig = PerceiverIOConfig[ImageEncoderConfig, ClassificationDecoderConfig]
+
+
+class ImageInputAdapter(InputAdapter):
+    """Flatten pixels (channels-last) and concatenate Fourier position
+    features (reference ``image_classifier/backend.py:30-48``)."""
+
+    image_shape: Tuple[int, int, int]
+    num_frequency_bands: int
+    dtype: Any = jnp.float32
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.image_shape[-1] + self._position_encoding.num_channels
+
+    @property
+    def _position_encoding(self) -> FourierPositionEncoding:
+        # Frozen dataclass, so no instance caching; the underlying table is
+        # lru_cached by (shape, bands) in ops.position.
+        return FourierPositionEncoding(self.image_shape[:-1], self.num_frequency_bands)
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, *d = x.shape
+        if tuple(d) != self.image_shape:
+            raise ValueError(
+                f"Input image shape {tuple(d)} different from required shape {self.image_shape}"
+            )
+        x = x.reshape(b, -1, self.image_shape[-1])
+        pos = self._position_encoding(b)
+        return jnp.concatenate([x, pos], axis=-1).astype(self.dtype)
+
+
+class ImageClassifier(nn.Module):
+    """Reference ``image_classifier/backend.py:51-88``: cross-attention qk
+    channels default to the adapter's input channel count."""
+
+    config: ImageClassifierConfig
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    def setup(self):
+        cfg = self.config
+        input_adapter = ImageInputAdapter(
+            image_shape=cfg.encoder.image_shape,
+            num_frequency_bands=cfg.encoder.num_frequency_bands,
+            dtype=self.dtype,
+        )
+        encoder_kwargs = cfg.encoder.base_kwargs()
+        if encoder_kwargs["num_cross_attention_qk_channels"] is None:
+            encoder_kwargs["num_cross_attention_qk_channels"] = input_adapter.num_input_channels
+        self.encoder = PerceiverEncoder(
+            input_adapter=input_adapter,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="encoder",
+            **encoder_kwargs,
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=ClassificationOutputAdapter(
+                num_classes=cfg.decoder.num_classes,
+                num_output_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            output_query_provider=TrainableQueryProvider(
+                num_queries=cfg.decoder.num_output_queries,
+                num_query_channels_=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            num_output_query_channels=cfg.decoder.num_output_query_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        x_latent = self.encoder(x, deterministic=deterministic)
+        return self.decoder(x_latent, deterministic=deterministic)

@@ -1,0 +1,164 @@
+"""Optical flow (Perceiver IO) — reference
+``perceiver/model/vision/optical_flow/backend.py``. Decoder queries are the
+adapted encoder input (per-pixel queries)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.core.adapter import InputAdapter
+from perceiver_io_tpu.models.core.config import (
+    DecoderConfig,
+    EncoderConfig,
+    PerceiverIOConfig,
+    register_config,
+)
+from perceiver_io_tpu.models.core.modules import PerceiverDecoder, PerceiverEncoder
+from perceiver_io_tpu.ops.position import FourierPositionEncoding
+
+
+@register_config
+@dataclass
+class OpticalFlowEncoderConfig(EncoderConfig):
+    """Reference ``optical_flow/backend.py:22-27``."""
+
+    image_shape: Tuple[int, int] = (368, 496)
+    num_patch_input_channels: int = 27
+    num_patch_hidden_channels: int = 64
+    num_frequency_bands: int = 64
+
+
+@register_config
+@dataclass
+class OpticalFlowDecoderConfig(DecoderConfig):
+    """Reference ``optical_flow/backend.py:30-33``."""
+
+    image_shape: Tuple[int, int] = (368, 496)
+    rescale_factor: float = 100.0
+
+
+OpticalFlowConfig = PerceiverIOConfig[OpticalFlowEncoderConfig, OpticalFlowDecoderConfig]
+
+
+class OpticalFlowInputAdapter(InputAdapter):
+    """Two frames of 3x3-patch features -> linear -> concat 2-D Fourier
+    encodings (reference ``optical_flow/backend.py:39-60``).
+
+    Input: ``(b, 2, c, h, w)`` — temporal frames concatenated in channels."""
+
+    image_shape: Tuple[int, int]
+    num_patch_input_channels: int
+    num_patch_hidden_channels: int
+    num_frequency_bands: int
+    init_scale: float = 0.02
+    dtype: Any = jnp.float32
+
+    @property
+    def _position_encoding(self) -> FourierPositionEncoding:
+        return FourierPositionEncoding(self.image_shape, self.num_frequency_bands)
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_patch_hidden_channels + self._position_encoding.num_channels
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, c, h, w = x.shape
+        # (b, t, c, h, w) -> (b, h, w, t*c): concatenate temporal frames in channels
+        x = x.transpose(0, 3, 4, 1, 2).reshape(b, h, w, t * c)
+        x = nn.Dense(
+            self.num_patch_hidden_channels,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="linear",
+        )(x)
+        x = x.reshape(b, h * w, self.num_patch_hidden_channels)
+        pos = self._position_encoding(b)
+        return jnp.concatenate([x, pos], axis=-1).astype(self.dtype)
+
+
+class OpticalFlowOutputAdapter(nn.Module):
+    """Linear to 2 flow channels, rescaled, reshaped to image grid (reference
+    ``optical_flow/backend.py:63-78``)."""
+
+    image_shape: Tuple[int, int]
+    num_output_query_channels: int
+    num_output_image_channels: int = 2
+    rescale_factor: float = 100.0
+    init_scale: float = 0.02
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Dense(
+            self.num_output_image_channels,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="linear",
+        )(x) / self.rescale_factor
+        b = x.shape[0]
+        h, w = self.image_shape
+        return x.reshape(b, h, w, self.num_output_image_channels)
+
+
+class OpticalFlow(nn.Module):
+    """Reference ``optical_flow/backend.py:95-137``: encoder qk/v channels
+    default to the adapter channel count; decoder queries = adapted input."""
+
+    config: OpticalFlowConfig
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+
+    def setup(self):
+        cfg = self.config
+        input_adapter = OpticalFlowInputAdapter(
+            image_shape=cfg.encoder.image_shape,
+            num_patch_input_channels=cfg.encoder.num_patch_input_channels,
+            num_patch_hidden_channels=cfg.encoder.num_patch_hidden_channels,
+            num_frequency_bands=cfg.encoder.num_frequency_bands,
+            init_scale=cfg.encoder.init_scale,
+            dtype=self.dtype,
+        )
+        encoder_kwargs = cfg.encoder.base_kwargs()
+        if encoder_kwargs["num_cross_attention_qk_channels"] is None:
+            encoder_kwargs["num_cross_attention_qk_channels"] = input_adapter.num_input_channels
+        if encoder_kwargs["num_cross_attention_v_channels"] is None:
+            encoder_kwargs["num_cross_attention_v_channels"] = input_adapter.num_input_channels
+        self.encoder = PerceiverEncoder(
+            input_adapter=input_adapter,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="encoder",
+            **encoder_kwargs,
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=OpticalFlowOutputAdapter(
+                image_shape=cfg.decoder.image_shape,
+                num_output_query_channels=input_adapter.num_input_channels,
+                rescale_factor=cfg.decoder.rescale_factor,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            output_query_provider=None,  # queries = adapted encoder input
+            num_latent_channels=cfg.num_latent_channels,
+            num_output_query_channels=input_adapter.num_input_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        x_latent, x_adapted = self.encoder(
+            x, return_adapted_input=True, deterministic=deterministic
+        )
+        return self.decoder(x_latent, x_adapted=x_adapted, deterministic=deterministic)

@@ -92,6 +92,24 @@ class RotaryEmbedding:
         return jnp.concatenate((t_rot.astype(t_dtype), t_pass), axis=-1)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _fourier_table(input_shape: Tuple[int, ...], num_frequency_bands: int) -> np.ndarray:
+    coords = [np.linspace(-1.0, 1.0, num=s, dtype=np.float32) for s in input_shape]
+    pos = np.stack(np.meshgrid(*coords, indexing="ij"), axis=-1)  # (*shape, d)
+    encodings = [pos]
+    grids = []
+    for i, max_freq in enumerate(input_shape):
+        freqs = np.linspace(1.0, max_freq / 2.0, num=num_frequency_bands, dtype=np.float32)
+        grids.append(pos[..., i : i + 1] * freqs)
+    encodings.extend([np.sin(math.pi * g) for g in grids])
+    encodings.extend([np.cos(math.pi * g) for g in grids])
+    enc = np.concatenate(encodings, axis=-1)
+    return enc.reshape(-1, enc.shape[-1])
+
+
 class FourierPositionEncoding:
     """N-D Fourier feature position encoding for grid-shaped inputs (images).
 
@@ -100,27 +118,16 @@ class FourierPositionEncoding:
     expanded with ``num_frequency_bands`` sin/cos features with frequencies
     linearly spaced in ``[1, max_freq/2]`` plus the raw coordinate.
 
-    The encoding is input-independent, so it is precomputed once with NumPy at
-    construction and becomes an XLA constant when used under ``jit``.
+    The encoding is input-independent; the table is built once per
+    (shape, bands) pair via an lru_cache (adapters and model ``setup`` may
+    construct this object many times per trace) and becomes an XLA constant
+    under ``jit``.
     """
 
     def __init__(self, input_shape: Sequence[int], num_frequency_bands: int):
         self.input_shape = tuple(input_shape)
         self.num_frequency_bands = num_frequency_bands
-        self._encoding = self._build()  # (prod(input_shape), C) float32
-
-    def _build(self) -> np.ndarray:
-        coords = [np.linspace(-1.0, 1.0, num=s, dtype=np.float32) for s in self.input_shape]
-        pos = np.stack(np.meshgrid(*coords, indexing="ij"), axis=-1)  # (*shape, d)
-        encodings = [pos]
-        grids = []
-        for i, max_freq in enumerate(self.input_shape):
-            freqs = np.linspace(1.0, max_freq / 2.0, num=self.num_frequency_bands, dtype=np.float32)
-            grids.append(pos[..., i : i + 1] * freqs)
-        encodings.extend([np.sin(math.pi * g) for g in grids])
-        encodings.extend([np.cos(math.pi * g) for g in grids])
-        enc = np.concatenate(encodings, axis=-1)
-        return enc.reshape(-1, enc.shape[-1])
+        self._encoding = _fourier_table(self.input_shape, num_frequency_bands)
 
     @property
     def num_channels(self) -> int:

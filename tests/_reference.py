@@ -1,0 +1,106 @@
+"""Loader for the torch reference implementation at /root/reference, used as
+the numerical-parity oracle (SURVEY.md §4: logits allclose at atol 1e-4).
+
+The environment lacks fairscale / pytorch_lightning / torchmetrics /
+pretty_midi, which the reference imports at package level. We install
+permissive stub modules for those names (enough for class definitions and
+decorators to import) — the backend model code under test never calls them.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.machinery
+import sys
+import types
+
+REFERENCE_PATH = "/root/reference"
+
+_STUB_PREFIXES = ("fairscale", "pytorch_lightning", "torchmetrics", "pretty_midi", "torchvision")
+
+
+class _StubAnything:
+    """Class usable as base class, decorator, callable, and attribute bag."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def __init_subclass__(cls, **kwargs):
+        pass
+
+    def __call__(self, *args, **kwargs):
+        # decorator usage: return the wrapped function unchanged
+        if len(args) == 1 and callable(args[0]) and not kwargs:
+            return args[0]
+        return self
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return _StubAnything()
+
+
+def _identity_wrapper(module, *args, **kwargs):
+    return module
+
+
+class _StubModule(types.ModuleType):
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        if name == "checkpoint_wrapper":
+            return _identity_wrapper
+        if name == "rank_zero_only":
+            return lambda fn: fn
+        # names used as base classes need to be actual classes
+        if name[:1].isupper():
+            return type(name, (_StubAnything,), {})
+        return _StubAnything()
+
+
+class _StubFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname.split(".")[0] not in _STUB_PREFIXES:
+            return None
+        # Prefer a real module if one is installed (PathFinder avoids
+        # re-entering this finder).
+        try:
+            if importlib.machinery.PathFinder.find_spec(fullname, path) is not None:
+                return None
+        except (ImportError, ValueError):
+            pass
+        return importlib.machinery.ModuleSpec(fullname, self, is_package=True)
+
+    def create_module(self, spec):
+        return _StubModule(spec.name)
+
+    def exec_module(self, module):
+        module.__path__ = []
+
+
+_installed = False
+
+
+def load_reference():
+    """Import and return the reference backend modules, or None if the
+    reference tree is unavailable."""
+    global _installed
+    import os
+
+    if not os.path.isdir(REFERENCE_PATH):
+        return None
+    if not _installed:
+        sys.meta_path.insert(0, _StubFinder())
+        sys.path.insert(0, REFERENCE_PATH)
+        _installed = True
+
+    mods = types.SimpleNamespace()
+    mods.core = importlib.import_module("perceiver.model.core.modules")
+    mods.core_config = importlib.import_module("perceiver.model.core.config")
+    mods.mlm = importlib.import_module("perceiver.model.text.mlm.backend")
+    mods.clm = importlib.import_module("perceiver.model.text.clm.backend")
+    mods.txt_clf = importlib.import_module("perceiver.model.text.classifier.backend")
+    mods.img_clf = importlib.import_module("perceiver.model.vision.image_classifier.backend")
+    mods.flow = importlib.import_module("perceiver.model.vision.optical_flow.backend")
+    mods.sam = importlib.import_module("perceiver.model.audio.symbolic.backend")
+    return mods

@@ -1,0 +1,252 @@
+"""Construction + forward-shape tests for all task backends (the reference's
+tiny-config pattern, e.g. tests/text_classifier_test.py:36-45)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.core.config import (
+    ClassificationDecoderConfig,
+    PerceiverIOConfig,
+    config_from_dict,
+    config_to_dict,
+)
+from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifierConfig
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.models.text.common import TextEncoderConfig
+from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel, TextDecoderConfig
+from perceiver_io_tpu.models.vision.image_classifier import (
+    ImageClassifier,
+    ImageEncoderConfig,
+)
+from perceiver_io_tpu.models.vision.optical_flow import (
+    OpticalFlow,
+    OpticalFlowDecoderConfig,
+    OpticalFlowEncoderConfig,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def tiny_text_encoder(**kwargs):
+    defaults = dict(
+        vocab_size=32,
+        max_seq_len=16,
+        num_input_channels=16,
+        num_cross_attention_heads=2,
+        num_self_attention_heads=2,
+        num_self_attention_layers_per_block=2,
+    )
+    defaults.update(kwargs)
+    return TextEncoderConfig(**defaults)
+
+
+class TestMaskedLanguageModel:
+    @pytest.mark.parametrize("tied", [True, False])
+    def test_forward(self, tied):
+        cfg = PerceiverIOConfig(
+            encoder=tiny_text_encoder(),
+            decoder=TextDecoderConfig(
+                vocab_size=32,
+                max_seq_len=16,
+                num_output_query_channels=None if tied else 12,
+                num_cross_attention_heads=2,
+                cross_attention_residual=False,
+            ),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+        model = MaskedLanguageModel(config=cfg)
+        ids = jnp.zeros((2, 10), jnp.int32)
+        v = model.init(KEY, ids)
+        logits = model.apply(v, ids)
+        # logits truncated to input length
+        assert logits.shape == (2, 10, 32)
+        if tied:
+            assert "output_adapter" in v["params"]["decoder"]
+            # tied path has no vocab projection kernel, only a bias
+            assert list(v["params"]["decoder"]["output_adapter"].keys()) == ["bias"]
+
+    def test_pad_mask(self, rng):
+        cfg = PerceiverIOConfig(
+            encoder=tiny_text_encoder(),
+            decoder=TextDecoderConfig(vocab_size=32, max_seq_len=16, num_cross_attention_heads=2),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+        model = MaskedLanguageModel(config=cfg)
+        ids = jnp.asarray(rng.integers(0, 32, (1, 10)), jnp.int32)
+        v = model.init(KEY, ids)
+        pad = jnp.zeros((1, 10), bool).at[0, 8:].set(True)
+        out1 = model.apply(v, ids, pad_mask=pad)
+        out2 = model.apply(v, ids.at[0, 8:].set(5), pad_mask=pad)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-5)
+
+
+class TestTextClassifier:
+    def test_forward(self):
+        cfg = PerceiverIOConfig(
+            encoder=tiny_text_encoder(),
+            decoder=ClassificationDecoderConfig(
+                num_classes=2, num_output_query_channels=16, num_cross_attention_heads=2
+            ),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+        model = TextClassifier(config=cfg)
+        ids = jnp.zeros((3, 10), jnp.int32)
+        v = model.init(KEY, ids)
+        logits = model.apply(v, ids)
+        assert logits.shape == (3, 2)
+
+
+class TestCausalLanguageModel:
+    def make_config(self, **kwargs):
+        # the reference generate-test config (tests/causal_language_model_generate_test.py:14-19)
+        defaults = dict(
+            vocab_size=262,
+            max_seq_len=12,
+            max_latents=6,
+            num_channels=16,
+            num_heads=2,
+            num_self_attention_layers=1,
+            cross_attention_dropout=0.5,
+        )
+        defaults.update(kwargs)
+        return CausalLanguageModelConfig(**defaults)
+
+    def test_forward_shape(self):
+        model = CausalLanguageModel(config=self.make_config())
+        ids = jnp.zeros((2, 10), jnp.int32)
+        v = model.init(KEY, ids, 4)
+        logits = model.apply(v, ids, 4)
+        assert logits.shape == (2, 6, 262)
+
+    def test_max_prefix_len_guard(self):
+        model = CausalLanguageModel(config=self.make_config())
+        assert model.max_prefix_len == 6
+        ids = jnp.zeros((2, 12), jnp.int32)
+        v = model.init(KEY, ids, 4)
+        with pytest.raises(ValueError, match="max_prefix_len"):
+            model.apply(v, ids, 7)
+
+    def test_abs_pos_emb_switch(self):
+        cfg = self.make_config(abs_pos_emb=False)
+        assert cfg.rotated_channels_per_head == 8
+        cfg2 = self.make_config(abs_pos_emb=True)
+        assert cfg2.rotated_channels_per_head == 4
+        model = CausalLanguageModel(config=cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        v = model.init(KEY, ids, 2)
+        adapter_params = v["params"]["perceiver_ar"]["input_adapter"]
+        assert "pos_embedding" not in adapter_params
+        assert model.apply(v, ids, 2).shape == (1, 6, 262)
+
+    def test_output_norm_switch(self):
+        model = CausalLanguageModel(config=self.make_config(output_norm=True))
+        ids = jnp.zeros((1, 8), jnp.int32)
+        v = model.init(KEY, ids, 2)
+        assert "out_norm" in v["params"]
+
+    def test_tied_embeddings_gradient_flows(self, rng):
+        """Loss gradients must reach the embedding through both the input
+        and the tied output path."""
+        model = CausalLanguageModel(config=self.make_config())
+        ids = jnp.asarray(rng.integers(0, 262, (1, 10)), jnp.int32)
+        v = model.init(KEY, ids, 4)
+
+        def loss(params):
+            logits = model.apply({"params": params}, ids, 4)
+            return -jax.nn.log_softmax(logits)[..., 0].mean()
+
+        g = jax.grad(loss)(v["params"])
+        emb_grad = g["perceiver_ar"]["input_adapter"]["txt_embedding"]["embedding"]
+        assert float(jnp.abs(emb_grad).sum()) > 0
+
+
+class TestImageClassifier:
+    def test_forward(self):
+        cfg = PerceiverIOConfig(
+            encoder=ImageEncoderConfig(
+                image_shape=(8, 8, 1),
+                num_frequency_bands=4,
+                num_cross_attention_heads=1,
+                num_self_attention_heads=2,
+                num_self_attention_layers_per_block=2,
+            ),
+            decoder=ClassificationDecoderConfig(
+                num_classes=10, num_output_query_channels=16, num_cross_attention_heads=2
+            ),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+        model = ImageClassifier(config=cfg)
+        imgs = jnp.ones((2, 8, 8, 1))
+        v = model.init(KEY, imgs)
+        logits = model.apply(v, imgs)
+        assert logits.shape == (2, 10)
+        # qk channels default to adapter input channels (1 + 2*(2*4+1) = 19)
+        qk = v["params"]["encoder"]["cross_attn_1"]["cross_attn"]["attention"]["q_proj"]["kernel"]
+        assert qk.shape == (16, 19)
+
+    def test_wrong_shape_raises(self):
+        cfg = PerceiverIOConfig(
+            encoder=ImageEncoderConfig(image_shape=(8, 8, 1), num_frequency_bands=4,
+                                       num_cross_attention_heads=1, num_self_attention_heads=2,
+                                       num_self_attention_layers_per_block=1),
+            decoder=ClassificationDecoderConfig(num_classes=10, num_output_query_channels=16,
+                                                num_cross_attention_heads=2),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+        model = ImageClassifier(config=cfg)
+        with pytest.raises(ValueError, match="shape"):
+            model.init(KEY, jnp.ones((2, 9, 8, 1)))
+
+
+class TestOpticalFlow:
+    def test_forward(self):
+        cfg = PerceiverIOConfig(
+            encoder=OpticalFlowEncoderConfig(
+                image_shape=(8, 12),
+                num_patch_input_channels=27,
+                num_patch_hidden_channels=16,
+                num_frequency_bands=4,
+                num_cross_attention_heads=1,
+                num_self_attention_heads=2,
+                num_self_attention_layers_per_block=2,
+            ),
+            decoder=OpticalFlowDecoderConfig(
+                image_shape=(8, 12), num_cross_attention_heads=1
+            ),
+            num_latents=8,
+            num_latent_channels=16,
+        )
+        model = OpticalFlow(config=cfg)
+        x = jnp.ones((2, 2, 27, 8, 12))
+        v = model.init(KEY, x)
+        flow = model.apply(v, x)
+        assert flow.shape == (2, 8, 12, 2)
+
+
+class TestSymbolicAudio:
+    def test_forward(self):
+        cfg = SymbolicAudioModelConfig(
+            vocab_size=389,
+            max_seq_len=12,
+            max_latents=6,
+            num_channels=16,
+            num_heads=2,
+            num_self_attention_layers=1,
+        )
+        model = SymbolicAudioModel(config=cfg)
+        ids = jnp.zeros((2, 10), jnp.int32)
+        v = model.init(KEY, ids, 4)
+        logits = model.apply(v, ids, 4)
+        assert logits.shape == (2, 6, 389)
+
+    def test_config_roundtrip(self):
+        cfg = SymbolicAudioModelConfig(max_seq_len=128, max_latents=32)
+        cfg2 = config_from_dict(None, config_to_dict(cfg))
+        assert type(cfg2) is SymbolicAudioModelConfig and cfg2 == cfg
