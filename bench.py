@@ -1,0 +1,122 @@
+"""Benchmark: Perceiver AR 8k-context training-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md), so the baseline
+is the north star from BASELINE.json: **0.8× an A100 on the same step**. The
+A100 step time is estimated analytically: training FLOPs (fwd + 2× bwd) on
+the same configuration at 312 bf16 TFLOP/s × 40% MFU — a generous MFU for
+the reference's eager torch implementation (no flash attention, no fusion;
+measured MFUs for it would be lower, making this baseline conservative).
+
+``vs_baseline`` > 1.0 means this framework beats that target.
+
+Config: the 8k-context north-star shape (BASELINE.json `configs`): Perceiver
+AR, vocab 262 (UTF-8 bytes), 8192 ctx / 1024 latents, 512 channels, 8 layers
+— the reference's WikiText-103 model (reference
+``examples/training/clm/train.py``) widened to the 8k context it targets for
+long-context work (``docs/training-examples.md:158-162`` scale).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.parallel import create_train_state, make_train_step, shard_batch, single_device_mesh
+from perceiver_io_tpu.training.tasks import clm_loss_fn
+
+BATCH = 8
+CFG = CausalLanguageModelConfig(
+    vocab_size=262,
+    max_seq_len=8192,
+    max_latents=1024,
+    num_channels=512,
+    num_heads=8,
+    num_self_attention_layers=8,
+    cross_attention_dropout=0.5,
+)
+
+A100_BF16_FLOPS = 312e12
+A100_ASSUMED_MFU = 0.40
+BASELINE_FACTOR = 0.8  # north star: >= 0.8x A100 step time
+
+
+def training_flops(cfg: CausalLanguageModelConfig, batch: int) -> float:
+    """Analytic training FLOPs per step (fwd + 2x bwd = 3x fwd), mirroring the
+    reference's scaling-study estimator (reference
+    ``examples/scaling/clm/scaling/flops.py:7-190``): dense matmul FLOPs +
+    attention score/value FLOPs."""
+    n, m, c = cfg.max_seq_len, cfg.max_latents, cfg.num_channels
+    v, L = cfg.vocab_size, cfg.num_self_attention_layers
+    wf_cross, wf_self = cfg.cross_attention_widening_factor, cfg.self_attention_widening_factor
+    # Cross-attention block: q over m, k/v over n, out over m, MLP over m.
+    cross = 2 * (m * c * c + 2 * n * c * c + m * c * c) + 2 * (2 * m * c * wf_cross * c)
+    cross_attn = 2 * 2 * m * n * c  # scores + weighted values
+    # Self-attention layer over m latents.
+    self_ = 2 * (4 * m * c * c) + 2 * (2 * m * c * wf_self * c)
+    self_attn = 2 * 2 * m * m * c
+    # Embedding lookup is a gather; output head is a matmul over m.
+    head = 2 * m * c * v
+    fwd = cross + cross_attn + L * (self_ + self_attn) + head
+    return 3.0 * batch * fwd
+
+
+def main() -> None:
+    devices = jax.devices()
+    mesh = single_device_mesh(devices[0])
+    model = CausalLanguageModel(CFG, dtype=jnp.bfloat16)
+    prefix_len = CFG.max_seq_len - CFG.max_latents
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, CFG.vocab_size, size=(BATCH, CFG.max_seq_len + 1), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    def init():
+        return model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, CFG.max_seq_len), jnp.int32), prefix_len
+        )["params"]
+
+    tx = optax.adamw(3e-4)
+    state, shardings = create_train_state(init, tx, mesh)
+    step = make_train_step(clm_loss_fn(model, CFG.max_latents), mesh, shardings)
+
+    with mesh:
+        sharded = shard_batch(batch, mesh)
+        key = jax.random.PRNGKey(1)
+        # Warmup / compile.
+        state, metrics = step(state, sharded, key)
+        jax.block_until_ready(metrics["loss"])
+        # Timed steps.
+        n_steps = 10
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, metrics = step(state, sharded, jax.random.fold_in(key, i))
+        jax.block_until_ready(metrics["loss"])
+        dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_sec = BATCH * CFG.max_seq_len / dt
+    flops = training_flops(CFG, BATCH)
+    a100_step_time = flops / (A100_BF16_FLOPS * A100_ASSUMED_MFU)
+    baseline_step_time = a100_step_time / BASELINE_FACTOR  # 0.8x a100 time target
+    vs_baseline = baseline_step_time / dt  # >1 == faster than target
+
+    print(
+        json.dumps(
+            {
+                "metric": "perceiver_ar_8k_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Inference layer — autoregressive generation as a single compile-once
+``lax.scan`` that keeps the whole decode loop on-device (the reference
+re-dispatches a Python-driven full forward per token, reference
+``perceiver/model/text/clm/huggingface.py:53-74``), plus logit samplers and
+MLM mask filling. A cached-decode fast path for the latent-growth phase is
+the planned perf-pass follow-up (see ``generate.py`` docstring for why exact
+caching interacts with the prefix/latent boundary).
+"""
+from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
+from perceiver_io_tpu.inference.generate import generate
+from perceiver_io_tpu.inference.mask_filler import MaskFiller
+
+__all__ = ["SamplingConfig", "sample_logits", "generate", "MaskFiller"]

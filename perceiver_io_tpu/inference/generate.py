@@ -1,0 +1,174 @@
+"""Autoregressive generation for Perceiver AR sequence models.
+
+Reference semantics (``perceiver/model/text/clm/huggingface.py:53-143``):
+the initial prompt tail of ``num_latents`` positions is latent; per generated
+token the latent count grows to ``max_latents``, then the prefix grows to
+``max_prefix_len``, then the window slides. The reference re-runs the full
+model per token from Python; here the **whole generation is one
+``lax.scan``** over a static-shape decode step, so it compiles once and stays
+on-device.
+
+Static shapes come from a right-aligned window formulation: the token window
+is always ``(b, max_seq_len)`` with left padding tracked by ``pad_count``;
+the latent segment is always the last ``max_latents`` positions, with a
+dynamic scalar ``m`` (true latent count) masking which of them are real
+latents. The phase schedule then reduces to ``m = min(m + 1, max_latents)``
+per token — no per-phase control flow. Garbage query rows (window positions
+classified latent but currently prefix) are computed and discarded; their
+keys are masked at every layer, so real rows match the reference's ragged
+computation exactly (same trick as the left-padded batches the reference
+supports natively, ``clm/lightning.py:71-77``).
+
+The prefix/latent boundary feeds the computation in two places that a KV
+cache must respect: boundary-side key normalization (prefix keys use
+``kv_norm``, latent keys use ``q_norm`` — reference ``modules.py:188-203``)
+and latent-stack membership. Both are masked dynamically here.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
+from perceiver_io_tpu.ops.position import RotaryEmbedding, positions
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 64
+    num_latents: int = 1
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    sampling: SamplingConfig = SamplingConfig()
+
+
+def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.ndarray):
+    """Static-shape forward over the right-aligned window; returns next-token
+    logits for the last position.
+
+    :param mdl: bound ``AutoregressiveSequenceModel``.
+    :param window: ``(b, N)`` tokens, right-aligned, left pads arbitrary ids.
+    :param pad_count: ``(b,)`` number of left-pad slots per row.
+    :param m: scalar — true latent count (last ``m`` window positions).
+    """
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents  # static query length I
+
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]  # (b, N) True = pad
+    abs_pos = positions(b, n, shift=pad_count[:, None])
+    emb, frq = ar.input_adapter(window, abs_pos=abs_pos)
+
+    # Cross-attention layer (reference CrossAttentionLayer with the
+    # x_kv_prefix path): latent-classified keys are q_norm'ed, prefix keys
+    # kv_norm'ed — selected by mask since the boundary is dynamic.
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    is_latent = (jnp.arange(n) >= n - num_latents)[None, :] & (
+        jnp.arange(n)[None, :] >= n - m
+    )
+    x_q_all = ca.q_norm(emb)
+    x_kv = jnp.where(is_latent[..., None], x_q_all, ca.kv_norm(emb))
+
+    x_q = x_q_all[:, -num_latents:]
+    rot_q = RotaryEmbedding(frq, right_align=True)
+    rot_k = RotaryEmbedding(frq, right_align=True)
+    q = mha.project_q(x_q, rot_q)
+    k, v = mha.project_kv(x_kv, rot_k)
+    attn = mha.attend(q, k, v, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb[:, -num_latents:]
+    x = layer.mlp(x) + x
+
+    # Self-attention stack over the (padded) latent segment. Positions that
+    # are not yet real latents are masked as keys at every layer; the
+    # reference passes no per-row pad mask to its stack (modules.py:730-733),
+    # so none is added here either.
+    stack_pad = jnp.broadcast_to(jnp.arange(num_latents)[None, :] < num_latents - m, (b, num_latents))
+    frq_latent = frq[:, -num_latents:]
+    x = ar.self_attention(
+        x, stack_pad, RotaryEmbedding(frq_latent, right_align=True), True
+    )
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(
+        x_last[:, None], ar.input_adapter.embeddings
+    )[:, 0]
+    return logits
+
+
+def generate(
+    model,
+    params,
+    input_ids: jnp.ndarray,
+    config: GenerationConfig,
+    *,
+    rng: Optional[jax.Array] = None,
+    prompt_pad_count: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Generate ``config.max_new_tokens`` tokens after ``input_ids``.
+
+    :param model: an ``AutoregressiveSequenceModel`` (CLM / symbolic audio).
+    :param input_ids: ``(b, prompt_len)`` prompt, left-padded if ragged.
+    :param prompt_pad_count: ``(b,)`` left-pad counts for ragged prompts.
+    :return: ``(b, max_new_tokens)`` generated ids (pad after EOS).
+    """
+    b, prompt_len = input_ids.shape
+    n = model.max_seq_len
+    max_latents = model.max_latents
+    if not 0 < prompt_len <= n:
+        raise ValueError(f"prompt length out of valid range [1..{n}]")
+    if not 0 < config.num_latents <= max_latents:
+        raise ValueError(
+            f"num_latents={config.num_latents} out of valid range [1..{max_latents}]"
+        )
+    num_latents = min(prompt_len, config.num_latents)
+    prefix_len = prompt_len - num_latents
+    if prefix_len > model.max_prefix_len:
+        raise ValueError(
+            f"for sequence length {prompt_len}, num_latents must be >= "
+            f"{num_latents + prefix_len - model.max_prefix_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if prompt_pad_count is None:
+        prompt_pad_count = jnp.zeros((b,), jnp.int32)
+
+    # Right-align the prompt into the full-size window.
+    window = jnp.full((b, n), config.pad_token_id, input_ids.dtype)
+    window = window.at[:, n - prompt_len :].set(input_ids)
+    pad_count = prompt_pad_count.astype(jnp.int32) + (n - prompt_len)
+
+    def step(carry, step_rng):
+        window, pad_count, m, finished = carry
+        logits = model.apply(
+            {"params": params},
+            window,
+            pad_count,
+            m,
+            method=_decode_forward,
+        )
+        token = sample_logits(step_rng, logits, config.sampling)
+        if config.eos_token_id is not None:
+            token = jnp.where(finished, config.pad_token_id, token)
+            finished = finished | (token == config.eos_token_id)
+        window = jnp.concatenate([window[:, 1:], token[:, None].astype(window.dtype)], axis=1)
+        pad_count = jnp.maximum(pad_count - 1, 0)
+        m = jnp.minimum(m + 1, max_latents)
+        return (window, pad_count, m, finished), token
+
+    carry = (
+        window,
+        pad_count,
+        jnp.asarray(num_latents, jnp.int32),
+        jnp.zeros((b,), bool),
+    )
+    _, tokens = jax.lax.scan(
+        step, carry, jax.random.split(rng, config.max_new_tokens)
+    )
+    return tokens.T.astype(input_ids.dtype)

@@ -1,0 +1,55 @@
+"""Top-k mask filling for masked language models (reference
+``perceiver/model/text/mlm/utils.py:4-27``): replace every ``<mask>`` token
+with its k-th most likely prediction and decode, yielding k filled variants
+per input text.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MaskFiller:
+    """:param preprocessor: a text preprocessor exposing ``tokenizer`` and
+    ``preprocess_batch(texts) -> (input_ids, pad_mask)`` (NumPy/JAX arrays),
+    e.g. :class:`perceiver_io_tpu.data.text.TextPreprocessor`."""
+
+    def __init__(self, preprocessor):
+        self.preprocessor = preprocessor
+        self._jit_apply = None  # built once on first fill()
+
+    def fill(
+        self,
+        model,
+        params,
+        masked_text_batch: Sequence[str],
+        num_predictions: int,
+    ) -> Tuple[List[str], List[List[str]]]:
+        tokenizer = self.preprocessor.tokenizer
+        masked_text_batch = [
+            ms.replace("<mask>", tokenizer.mask_token) for ms in masked_text_batch
+        ]
+        xs, pad_mask = self.preprocessor.preprocess_batch(masked_text_batch)
+        xs = np.asarray(xs)
+
+        if self._jit_apply is None:
+            self._jit_apply = jax.jit(
+                lambda p, x, m: model.apply({"params": p}, x, pad_mask=m)
+            )
+        logits = self._jit_apply(params, jnp.asarray(xs), jnp.asarray(pad_mask))
+
+        pred_mask = xs == tokenizer.mask_token_id
+        masked_logits = np.asarray(logits)[pred_mask, :]
+        # top-k prediction ids per masked position, most likely first
+        pred_ids = np.argsort(-masked_logits, axis=-1)[:, :num_predictions]
+
+        results = []
+        filled = xs.copy()
+        for i in range(num_predictions):
+            filled[pred_mask] = pred_ids[:, i]
+            results.append(tokenizer.batch_decode(filled, skip_special_tokens=True))
+
+        return masked_text_batch, list(map(list, zip(*results)))

@@ -1,0 +1,64 @@
+"""Logit samplers: greedy, temperature, top-k, nucleus (top-p) — the sampling
+modes the reference exercises through HF ``GenerationMixin`` (reference
+``tests/causal_language_model_pipeline_test.py:17-48``), as pure jittable
+functions.
+
+Filters compose in HF's order: temperature → top-k → top-p.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the k highest logits per row, mask the rest to -inf. ``k`` is
+    clamped to the vocabulary size (HF GenerationMixin behavior)."""
+    k = min(k, logits.shape[-1])
+    kth = jnp.sort(logits, axis=-1)[..., -k : -k + 1] if k > 1 else jnp.max(
+        logits, axis=-1, keepdims=True
+    )
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest set of tokens with cumulative
+    probability ≥ p (the most-probable token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # mask tokens whose *preceding* cumulative mass already reached p
+    sorted_keep = (cum - probs) < p
+    # threshold logit = smallest kept logit
+    kth = jnp.min(jnp.where(sorted_keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def sample_logits(
+    rng: jax.Array, logits: jnp.ndarray, config: SamplingConfig
+) -> jnp.ndarray:
+    """:param logits: ``(b, vocab)`` next-token logits.
+    :return: ``(b,)`` int32 sampled token ids."""
+    logits = logits.astype(jnp.float32)
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if config.temperature != 1.0:
+        logits = logits / config.temperature
+    if config.top_k is not None and config.top_k > 0:
+        logits = apply_top_k(logits, config.top_k)
+    if config.top_p is not None and config.top_p < 1.0:
+        logits = apply_top_p(logits, config.top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

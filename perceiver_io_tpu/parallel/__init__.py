@@ -1,0 +1,47 @@
+"""Parallelism & distributed runtime — the TPU-native replacement for the
+reference's Lightning strategy layer (DDP/FSDP over NCCL, reference
+``perceiver/scripts/trainer.yaml:14``, ``perceiver/scripts/text/clm_fsdp.py``).
+
+Design (SURVEY.md §2.5): one ``jax.sharding.Mesh`` with named axes
+
+- ``data``  — pure data parallelism (batch sharding, gradient allreduce);
+- ``fsdp``  — fully-sharded data parallelism: batch *and* parameters sharded,
+  XLA GSPMD inserts the all-gather/reduce-scatter that torch FSDP does by
+  hand;
+- ``model`` — tensor parallelism (attention heads / MLP hidden);
+- ``seq``   — sequence/context parallelism for long sequences.
+
+All collectives are emitted by XLA from :class:`~jax.sharding.PartitionSpec`
+annotations — there is no hand-written NCCL/MPI equivalent to port.
+"""
+from perceiver_io_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
+from perceiver_io_tpu.parallel.partition import (
+    batch_sharding,
+    batch_spec,
+    infer_param_specs,
+    param_shardings,
+    shard_batch,
+    shard_params,
+)
+from perceiver_io_tpu.parallel.train_step import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "batch_sharding",
+    "infer_param_specs",
+    "param_shardings",
+    "shard_batch",
+    "shard_params",
+    "TrainState",
+    "create_train_state",
+    "make_eval_step",
+    "make_train_step",
+    "state_shardings",
+]

@@ -1,0 +1,150 @@
+"""Parameter & batch partitioning rules.
+
+Replaces the reference's FSDP wrap policy (``transformer_auto_wrap_policy``
+over attention layers, reference ``perceiver/scripts/text/clm_fsdp.py:24-37``)
+with declarative ``PartitionSpec`` rules — XLA GSPMD then emits the
+all-gathers and reduce-scatters torch FSDP performs imperatively.
+
+Two composable rule sets:
+
+- **Tensor parallelism** (``model`` axis): attention head projections are
+  sharded on the head dimension (q/k/v output, o input), the MLP on its
+  hidden dimension. These are the canonical Megatron shardings, which make
+  the two collectives per layer an all-reduce of activations.
+- **FSDP** (``fsdp`` axis): every parameter's largest still-unsharded,
+  evenly-divisible dimension is sharded. Parameters too small to split
+  stay replicated (same effect as torch FSDP leaving small leaves in the
+  root wrap unit).
+
+The rules operate on flax param-path strings, so they apply uniformly to
+every model family in :mod:`perceiver_io_tpu.models`.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from perceiver_io_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL, AXIS_SEQ, BATCH_AXES
+
+# (path regex, dim) — dim of the kernel to shard over the `model` axis.
+# Column-parallel (output dim): q/k/v projections, MLP up-projection.
+# Row-parallel (input dim): attention output projection, MLP down-projection.
+_TP_KERNEL_RULES: Tuple[Tuple[str, int], ...] = (
+    (r"(q_proj|k_proj|v_proj)/kernel$", 1),
+    (r"o_proj/kernel$", 0),
+    (r"mlp/hidden/kernel$", 1),
+    (r"mlp/out/kernel$", 0),
+)
+
+# Biases of column-parallel layers follow their kernel's output sharding;
+# row-parallel biases stay replicated (added after the allreduce).
+_TP_BIAS_RULES: Tuple[str, ...] = (
+    r"(q_proj|k_proj|v_proj)/bias$",
+    r"mlp/hidden/bias$",
+)
+
+
+def _tp_spec(path: str, shape: Tuple[int, ...], model_size: int) -> list:
+    spec: list = [None] * len(shape)
+    if model_size <= 1:
+        return spec
+    for pattern, dim in _TP_KERNEL_RULES:
+        if re.search(pattern, path) and shape[dim] % model_size == 0:
+            spec[dim] = AXIS_MODEL
+            return spec
+    for pattern in _TP_BIAS_RULES:
+        if re.search(pattern, path) and shape[-1] % model_size == 0:
+            spec[-1] = AXIS_MODEL
+            return spec
+    return spec
+
+
+def infer_param_spec(
+    path: str,
+    value: Any,
+    mesh: Mesh,
+    *,
+    min_fsdp_size: int = 2**14,
+) -> P:
+    """PartitionSpec for one parameter: TP rules first, then FSDP shards the
+    largest remaining dimension. ``min_fsdp_size`` keeps tiny leaves (norms,
+    biases) replicated — gathering them costs more than storing them."""
+    shape = tuple(np.shape(value))
+    spec = _tp_spec(path, shape, mesh.shape.get(AXIS_MODEL, 1))
+
+    fsdp_size = mesh.shape.get(AXIS_FSDP, 1)
+    if fsdp_size > 1 and np.size(value) >= min_fsdp_size:
+        dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+        for d in dims:
+            if spec[d] is None and shape[d] % fsdp_size == 0:
+                spec[d] = AXIS_FSDP
+                break
+    return P(*spec)
+
+
+def _flatten_path(key_path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+    )
+
+
+def infer_param_specs(params, mesh: Mesh, *, min_fsdp_size: int = 2**14):
+    """Pytree of PartitionSpecs matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, v: infer_param_spec(
+            _flatten_path(kp), v, mesh, min_fsdp_size=min_fsdp_size
+        ),
+        params,
+    )
+
+
+def param_shardings(params_or_specs, mesh: Mesh):
+    """NamedShardings for a param pytree (or a pytree of PartitionSpecs)."""
+    def to_sharding(leaf):
+        spec = leaf if isinstance(leaf, P) else None
+        if spec is None:
+            raise TypeError("expected a pytree of PartitionSpec")
+        return NamedSharding(mesh, spec)
+
+    if all(isinstance(l, P) for l in jax.tree_util.tree_leaves(params_or_specs)):
+        return jax.tree_util.tree_map(to_sharding, params_or_specs)
+    specs = infer_param_specs(params_or_specs, mesh)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a (host or single-device) param pytree onto the mesh according
+    to the inferred specs — the moment FSDP materializes its shards."""
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def batch_spec(mesh: Mesh, *, ndim: int = 2, shard_seq: bool = False) -> P:
+    """PartitionSpec for a batch array: leading dim over (data, fsdp), and
+    optionally the sequence dim over ``seq`` (context parallelism)."""
+    spec: list = [BATCH_AXES] + [None] * (ndim - 1)
+    if shard_seq and ndim > 1 and mesh.shape.get(AXIS_SEQ, 1) > 1:
+        spec[1] = AXIS_SEQ
+    return P(*spec)
+
+
+def batch_sharding(mesh: Mesh, *, ndim: int = 2, shard_seq: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh, ndim=ndim, shard_seq=shard_seq))
+
+
+def shard_batch(batch, mesh: Mesh, *, shard_seq: bool = False):
+    """Device-put a pytree of host batch arrays with batch-dim sharding.
+
+    On multi-host pods, per-host arrays should instead be assembled with
+    ``jax.make_array_from_process_local_data`` — see
+    :mod:`perceiver_io_tpu.parallel.multihost`.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, batch_sharding(mesh, ndim=np.ndim(x), shard_seq=shard_seq)
+        ),
+        batch,
+    )

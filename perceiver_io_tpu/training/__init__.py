@@ -1,0 +1,29 @@
+"""Training layer — a Lightning-free trainer with the reference's training
+semantics (reference ``perceiver/model/core/lightning.py``,
+``perceiver/scripts/cli.py``, ``perceiver/scripts/lrs.py``):
+
+- optax optimizers + warmup schedules stepped per optimizer step;
+- task step functions (CLM/MLM/classifier) producing loss + metrics;
+- orbax checkpointing monitored on ``val_loss`` with config metadata;
+- metric logging (TensorBoard when available, JSONL always);
+- rank-0 qualitative sampling callbacks at validation epochs.
+"""
+from perceiver_io_tpu.training.lrs import constant_with_warmup, cosine_with_warmup
+from perceiver_io_tpu.training.optim import make_optimizer
+from perceiver_io_tpu.training.tasks import (
+    classifier_loss_fn,
+    clm_loss_fn,
+    mlm_loss_fn,
+)
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "constant_with_warmup",
+    "cosine_with_warmup",
+    "make_optimizer",
+    "classifier_loss_fn",
+    "clm_loss_fn",
+    "mlm_loss_fn",
+    "Trainer",
+    "TrainerConfig",
+]

@@ -1,0 +1,120 @@
+"""Checkpointing — orbax-backed, with the reference's three interoperable
+forms (SURVEY.md §5.4):
+
+- **trainer checkpoints**: best-``val_loss``-monitored, weights-only by
+  default (reference ``perceiver/scripts/trainer.yaml:7-12``), with the model
+  config embedded as metadata so a checkpoint alone rebuilds the model
+  (``save_hyperparameters()`` parity);
+- **pretrained dirs**: ``save_pretrained``/``load_pretrained`` — params +
+  config, the HF-dir equivalent consumed by the inference pipelines;
+- **warm-start graph**: ``load_subtree`` pulls a sub-pytree (e.g. just the
+  encoder) out of any checkpoint into a fresh model — the two-stage
+  classifier flow (reference ``classifier/lightning.py:30-37``).
+
+Sharded ``jax.Array`` trees save and restore natively (each host writes its
+shards); restore takes an abstract target so a checkpoint written on one mesh
+reloads onto another — something torch FSDP checkpoints cannot do without
+consolidation.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from perceiver_io_tpu.models.core.config import config_from_dict, config_to_dict
+
+CONFIG_FILE = "config.json"
+PARAMS_DIR = "params"
+
+
+def save_pretrained(path: str, params: Any, config: Any, *, extra: Optional[dict] = None) -> None:
+    """Write a self-describing model dir: orbax params + JSON config."""
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    meta = {"model_config": config_to_dict(config)}
+    if extra:
+        meta.update(extra)
+    with open(os.path.join(path, CONFIG_FILE), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, PARAMS_DIR), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_config(path: str) -> Any:
+    with open(os.path.join(os.path.abspath(path), CONFIG_FILE)) as f:
+        meta = json.load(f)
+    return config_from_dict(None, meta["model_config"])
+
+
+def load_pretrained(path: str, *, target: Any = None):
+    """:return: (params, config). ``target`` — an abstract pytree (e.g. from
+    ``jax.eval_shape``) with shardings for direct-to-mesh restore; omit for
+    host restore."""
+    path = os.path.abspath(path)
+    config = load_config(path)
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(path, PARAMS_DIR), target)
+    return params, config
+
+
+def load_subtree(path: str, subtree: str, *, target: Any = None):
+    """Load one sub-pytree (``'encoder'``, ``'perceiver_ar'`` …) from a saved
+    model — partial/pretrained-subtree warm start."""
+    params, _ = load_pretrained(path, target=None)
+    node = params
+    for key in subtree.split("/"):
+        node = node[key]
+    if target is not None:
+        node = jax.tree_util.tree_map(lambda t, x: jax.device_put(x, t.sharding), target, node)
+    return node
+
+
+class BestCheckpointManager:
+    """Keeps the k best checkpoints by ``val_loss`` — the reference's
+    ``ModelCheckpoint(monitor="val_loss", save_weights_only=True)``
+    (``trainer.yaml:7-12``). Checkpoint dirs are named
+    ``step=<n>-val_loss=<v>`` like the reference's ``.ckpt`` files."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 1):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                best_fn=lambda metrics: metrics["val_loss"],
+                best_mode="min",
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step: int, params: Any, config: Any, val_loss: float) -> None:
+        with open(os.path.join(self.directory, CONFIG_FILE), "w") as f:
+            json.dump({"model_config": config_to_dict(config)}, f, indent=2, default=str)
+        self._manager.save(
+            step,
+            args=ocp.args.StandardSave(params),
+            metrics={"val_loss": float(val_loss)},
+        )
+        self._manager.wait_until_finished()
+
+    @property
+    def best_step(self) -> Optional[int]:
+        return self._manager.best_step()
+
+    def restore_best(self, *, target: Any = None):
+        step = self.best_step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        params = self._manager.restore(step, args=ocp.args.StandardRestore(target))
+        with open(os.path.join(self.directory, CONFIG_FILE)) as f:
+            config = config_from_dict(None, json.load(f)["model_config"])
+        return params, config
+
+    def close(self):
+        self._manager.close()

@@ -1,0 +1,40 @@
+"""Learning-rate schedules (reference ``perceiver/scripts/lrs.py:7-38``),
+as optax schedules stepped once per optimizer step (the reference configures
+its schedulers with ``interval="step"``, ``perceiver/scripts/cli.py:44-47``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cosine_with_warmup(
+    base_lr: float,
+    *,
+    warmup_steps: int,
+    training_steps: int,
+    min_fraction: float = 1e-1,
+) -> optax.Schedule:
+    """Linear warmup then cosine decay to ``min_fraction * base_lr``
+    (reference ``CosineWithWarmupLR``, ``lrs.py:7-27``)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warmup = step / jnp.maximum(1.0, warmup_steps)
+        progress = (step - warmup_steps) / jnp.maximum(1.0, training_steps - warmup_steps)
+        progress = jnp.clip(progress, 0.0, 1.0)
+        cosine = min_fraction + (1.0 - min_fraction) * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        return base_lr * jnp.where(step < warmup_steps, warmup, cosine)
+
+    return schedule
+
+
+def constant_with_warmup(base_lr: float, *, warmup_steps: int) -> optax.Schedule:
+    """Linear warmup then constant (reference ``ConstantWithWarmupLR``,
+    ``lrs.py:30-38``)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        return base_lr * jnp.minimum(1.0, step / jnp.maximum(1.0, warmup_steps))
+
+    return schedule

@@ -1,0 +1,58 @@
+"""Optimizer construction: optax chains with optional parameter freezing.
+
+Freezing replaces the reference's ``requires_grad=False`` pattern (two-stage
+text-classifier training loads an MLM encoder and freezes it, reference
+``perceiver/model/text/classifier/lightning.py:30-37``,
+``perceiver/model/core/utils.py:37-39``): frozen subtrees get
+``optax.set_to_zero`` via ``optax.multi_transform``, so their parameters and
+optimizer state never change (and Adam allocates no moments for them).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import optax
+
+ScheduleOrFloat = Union[float, optax.Schedule]
+
+
+def make_optimizer(
+    learning_rate: ScheduleOrFloat,
+    *,
+    optimizer: str = "adamw",
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    frozen_prefixes: Sequence[str] = (),
+) -> optax.GradientTransformation:
+    """Build the training transformation.
+
+    :param frozen_prefixes: flax param-path prefixes (e.g. ``("encoder",)``)
+        whose parameters are excluded from updates.
+    """
+    if optimizer == "adamw":
+        tx = optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
+    elif optimizer == "adam":
+        tx = optax.adam(learning_rate, b1=b1, b2=b2)
+    elif optimizer == "sgd":
+        tx = optax.sgd(learning_rate)
+    elif optimizer == "lamb":
+        tx = optax.lamb(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+
+    if not frozen_prefixes:
+        return tx
+
+    def label_fn(params):
+        import jax
+
+        def label(key_path, _):
+            path = "/".join(str(getattr(k, "key", k)) for k in key_path)
+            return "frozen" if any(path.startswith(p) for p in frozen_prefixes) else "trainable"
+
+        return jax.tree_util.tree_map_with_path(label, params)
+
+    return optax.multi_transform(
+        {"trainable": tx, "frozen": optax.set_to_zero()}, label_fn
+    )

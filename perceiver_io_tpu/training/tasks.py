@@ -1,0 +1,99 @@
+"""Task step functions — the training semantics of the reference's Lightning
+wrappers, as pure ``(params, batch, rng) -> (loss, metrics)`` functions for
+:func:`perceiver_io_tpu.parallel.make_train_step`.
+
+Batches are dicts with the reference's collator fields (``labels``,
+``input_ids``, ``pad_mask``; reference ``perceiver/data/text/collator.py:16-22``
+uses a tuple — a dict is the pytree-friendly equivalent).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100  # torch cross_entropy ignore_index, used throughout the reference
+
+
+def masked_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Token-mean CE ignoring ``IGNORE_INDEX`` labels — semantics of torch
+    ``F.cross_entropy(logits, labels)`` with default mean reduction."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = labels != IGNORE_INDEX
+    safe = jnp.where(valid, labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(1, valid.sum())
+
+
+def _rngs(rng) -> Optional[dict]:
+    if rng is None:
+        return None
+    d, p = jax.random.split(rng)
+    return {"dropout": d, "prefix": p}
+
+
+def clm_loss_fn(model, max_latents: int) -> Callable:
+    """Perceiver AR causal-LM step: ``prefix_len = seq_len - max_latents``,
+    pad labels ignored, loss on the last ``max_latents`` positions only
+    (reference ``perceiver/model/text/clm/lightning.py:86-102``)."""
+
+    def loss_fn(params, batch, rng):
+        input_ids = batch["input_ids"]
+        labels = batch["labels"]
+        pad_mask = batch.get("pad_mask")
+        prefix_len = input_ids.shape[1] - max_latents
+        if pad_mask is not None:
+            labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
+        logits = model.apply(
+            {"params": params},
+            input_ids,
+            prefix_len,
+            pad_mask=pad_mask,
+            deterministic=rng is None,
+            rngs=_rngs(rng),
+        )
+        loss = masked_cross_entropy(logits, labels[:, prefix_len:])
+        return loss, {}
+
+    return loss_fn
+
+
+def mlm_loss_fn(model) -> Callable:
+    """Masked-LM step: CE over all positions, unmasked labels = -100
+    (reference ``perceiver/model/text/mlm/lightning.py:57-62``)."""
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            pad_mask=batch.get("pad_mask"),
+            deterministic=rng is None,
+            rngs=_rngs(rng),
+        )
+        loss = masked_cross_entropy(logits, batch["labels"])
+        return loss, {}
+
+    return loss_fn
+
+
+def classifier_loss_fn(model) -> Callable:
+    """Classifier step: CE + accuracy (reference
+    ``perceiver/model/core/lightning.py:50-76``; accuracy reduction across
+    devices comes from sharding, the ``sync_dist=True`` equivalent)."""
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["x"],
+            pad_mask=batch.get("pad_mask"),
+            deterministic=rng is None,
+            rngs=_rngs(rng),
+        )
+        labels = batch["labels"]
+        loss = masked_cross_entropy(logits, labels)
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    return loss_fn

@@ -1,0 +1,145 @@
+"""Sharded training tests on the 8-device virtual CPU mesh — the multi-chip
+coverage SURVEY.md §4 calls for (the reference has no distributed tests; its
+DDP/FSDP paths are exercised only by example shell scripts).
+
+The oracle: a jitted sharded train step must produce the same loss trajectory
+as the unsharded single-device step, for every mesh layout (DP, FSDP, TP and
+combinations). That is exactly the guarantee DDP/FSDP give in torch.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.parallel import (
+    MeshConfig,
+    TrainState,
+    create_train_state,
+    infer_param_specs,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+)
+from perceiver_io_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL
+
+VOCAB, SEQ, LATENTS, CH, HEADS = 32, 16, 8, 32, 4
+
+
+def tiny_clm():
+    cfg = CausalLanguageModelConfig(
+        vocab_size=VOCAB,
+        max_seq_len=SEQ,
+        max_latents=LATENTS,
+        num_channels=CH,
+        num_heads=HEADS,
+        num_self_attention_layers=2,
+        cross_attention_dropout=0.0,
+    )
+    return CausalLanguageModel(cfg)
+
+
+def make_loss_fn(model, prefix_len):
+    def loss_fn(params, batch, rng):
+        input_ids, labels = batch["input_ids"], batch["labels"]
+        rngs = {"dropout": rng, "prefix": rng} if rng is not None else None
+        logits = model.apply(
+            {"params": params},
+            input_ids,
+            prefix_len,
+            deterministic=rng is None,
+            rngs=rngs,
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = labels[:, prefix_len:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return nll.mean(), {}
+
+    return loss_fn
+
+
+def make_batch(rng, batch_size=8):
+    ids = rng.integers(0, VOCAB, size=(batch_size, SEQ + 1), dtype=np.int32)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14):
+    model = tiny_clm()
+    mesh = make_mesh(mesh_config)
+    rng = np.random.default_rng(0)
+    prefix_len = SEQ - LATENTS
+
+    def init():
+        return model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32), prefix_len
+        )["params"]
+
+    tx = optax.adam(1e-2)
+    state, shardings = create_train_state(init, tx, mesh, min_fsdp_size=min_fsdp_size)
+    step = make_train_step(
+        make_loss_fn(model, prefix_len), mesh, shardings, grad_clip_norm=1.0
+    )
+
+    losses = []
+    with mesh:
+        for i in range(n_steps):
+            batch = shard_batch(make_batch(rng, batch_size), mesh)
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            losses.append(float(metrics["loss"]))
+    return losses, state, mesh
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Single-logical-device trajectory (1×1×1×1 mesh over device 0)."""
+    return run_steps(MeshConfig(data=1))[0]
+
+
+@pytest.mark.parametrize(
+    "mesh_config",
+    [
+        MeshConfig(data=8),
+        MeshConfig(data=1, fsdp=8),
+        MeshConfig(data=2, fsdp=4),
+        MeshConfig(data=2, fsdp=2, model=2),
+        MeshConfig(data=1, fsdp=2, model=4),
+    ],
+    ids=["dp8", "fsdp8", "dp2xfsdp4", "dp2xfsdp2xtp2", "fsdp2xtp4"],
+)
+def test_sharded_matches_single_device(baseline, mesh_config):
+    losses, _, _ = run_steps(mesh_config)
+    np.testing.assert_allclose(losses, baseline, rtol=2e-4)
+
+
+def test_fsdp_actually_shards_params_and_opt_state():
+    # min_fsdp_size=0: the test model is tiny, so force sharding of all leaves.
+    _, state, mesh = run_steps(MeshConfig(data=1, fsdp=8), n_steps=1, min_fsdp_size=0)
+    emb = state.params["perceiver_ar"]["input_adapter"]["txt_embedding"]["embedding"]
+    assert emb.sharding.spec != jax.sharding.PartitionSpec()  # sharded
+    # Adam mu mirrors the param sharding (ZeRO-style optimizer sharding).
+    mu = state.opt_state[0].mu["perceiver_ar"]["input_adapter"]["txt_embedding"]["embedding"]
+    assert mu.sharding.spec == emb.sharding.spec
+    # A single shard holds 1/8 of the rows.
+    shard = emb.addressable_shards[0]
+    assert shard.data.shape[0] * 8 == emb.shape[0] or shard.data.shape[1] * 8 == emb.shape[1]
+
+
+def test_tp_shards_attention_heads():
+    model = tiny_clm()
+    mesh = make_mesh(MeshConfig(data=2, fsdp=1, model=4))
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS
+        )["params"]
+    )
+    specs = infer_param_specs(shapes, mesh)
+    sa = specs["perceiver_ar"]["self_attention"]["layers_0"]["self_attn"]["attention"]
+    assert sa["q_proj"]["kernel"] == jax.sharding.PartitionSpec(None, AXIS_MODEL)
+    assert sa["o_proj"]["kernel"] == jax.sharding.PartitionSpec(AXIS_MODEL, None)
+
+
+def test_grad_norm_logged():
+    losses, state, mesh = run_steps(MeshConfig(data=4, fsdp=2), n_steps=2)
+    assert len(losses) == 2 and all(np.isfinite(losses))
+    assert int(state.step) == 2
