@@ -1,0 +1,17 @@
+"""Host-side data layer (NumPy until ``device_put``) — the capability surface
+of the reference's ``perceiver/data/`` package (SURVEY.md §2.3), re-designed
+for TPU input pipelines:
+
+- **static shapes**: collators pad to a fixed ``max_seq_len`` so every batch
+  compiles once (the reference pads to the batch max, which would retrace XLA).
+- **per-host sharding**: loaders shard by ``(shard_index, shard_count)`` —
+  wired to ``jax.process_index()/process_count()`` on pods — replacing the
+  reference's ``torch.distributed`` rank-based sharding
+  (``perceiver/data/text/c4.py:56-79``).
+- **flat tensor storage**: preprocessed token chunks are stored as 2-D
+  ``np.memmap``-able arrays instead of arrow datasets; a chunked dataset is
+  literally one ``(num_chunks, chunk_size)`` int32 array.
+"""
+from perceiver_io_tpu.data.loader import DataLoader, host_shard_info
+
+__all__ = ["DataLoader", "host_shard_info"]

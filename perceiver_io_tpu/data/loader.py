@@ -1,0 +1,147 @@
+"""Sharded, prefetching batch loader.
+
+Replaces the reference's ``torch.utils.data.DataLoader`` usage (e.g.
+``perceiver/data/text/common.py:206-234``) with a dependency-free loader that
+
+- shards the index space across hosts (``jax.process_index()`` on pods),
+- shuffles deterministically per epoch from a seed,
+- collates map-style examples into dict-of-NumPy batches,
+- prefetches batches on a background thread so host preprocessing overlaps
+  with TPU step time (the reference relies on worker processes + pinned
+  memory for the same effect).
+
+Batches are dicts of NumPy arrays; ``parallel.shard_batch`` moves them onto
+the mesh inside the trainer.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def host_shard_info() -> tuple[int, int]:
+    """(shard_index, shard_count) for the current host — ``jax.process_index``
+    / ``process_count`` when jax is initialised, else (0, 1)."""
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def default_collate(examples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    """Stack same-keyed example dicts into a batch dict."""
+    out = {}
+    for key in examples[0]:
+        out[key] = np.stack([np.asarray(e[key]) for e in examples], axis=0)
+    return out
+
+
+class DataLoader:
+    """Map-style loader: ``dataset[i] -> example dict``, collated into batches.
+
+    :param dataset: anything with ``__len__`` and ``__getitem__``.
+    :param batch_size: per-host batch size (global batch = batch_size ×
+        shard_count when every host runs its own loader).
+    :param shuffle: reshuffle the index space every epoch.
+    :param seed: base RNG seed; epoch ``e`` uses ``seed + e`` so ordering is
+        reproducible and differs between epochs.
+    :param shard_index/shard_count: this host's slice of the index space.
+        Defaults to :func:`host_shard_info`. Sharding happens *after* the
+        epoch shuffle so every host sees a disjoint, epoch-varying subset.
+    :param drop_last: drop the trailing partial batch (keeps shapes static —
+        on TPU a partial batch would trigger a recompile; default True).
+    :param collate_fn: ``examples -> batch dict``; default stacks arrays.
+    :param prefetch: number of batches buffered on a background thread
+        (0 disables threading).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        shard_index: Optional[int] = None,
+        shard_count: Optional[int] = None,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable] = None,
+        prefetch: int = 2,
+    ):
+        if shard_index is None or shard_count is None:
+            auto_index, auto_count = host_shard_info()
+            shard_index = auto_index if shard_index is None else shard_index
+            shard_count = auto_count if shard_count is None else shard_count
+        if not 0 <= shard_index < shard_count:
+            raise ValueError(f"invalid shard {shard_index}/{shard_count}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.shard_index = shard_index
+        self.shard_count = shard_count
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate
+        self.prefetch = prefetch
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def _epoch_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + self._epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        return order[self.shard_index :: self.shard_count]
+
+    def __len__(self) -> int:
+        n = len(self._epoch_indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        indices = self._epoch_indices()
+        limit = len(indices)
+        if self.drop_last:
+            limit = (limit // self.batch_size) * self.batch_size
+        for start in range(0, limit, self.batch_size):
+            chunk = indices[start : start + self.batch_size]
+            if not len(chunk):
+                return
+            yield self.collate_fn([self.dataset[int(i)] for i in chunk])
+        self._epoch += 1  # auto-advance so re-iteration reshuffles
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        err: list = []
+
+        def worker():
+            try:
+                for batch in self._batches():
+                    q.put(batch)
+            except BaseException as e:  # surface worker errors in the consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]

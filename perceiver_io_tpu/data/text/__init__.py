@@ -1,0 +1,56 @@
+"""Text data pipelines (reference ``perceiver/data/text/``, SURVEY.md §2.3)."""
+from perceiver_io_tpu.data.text.collators import (
+    DefaultCollator,
+    RandomTruncateCollator,
+    TokenMaskingCollator,
+    WordMaskingCollator,
+)
+from perceiver_io_tpu.data.text.datamodule import (
+    ChunkedTokenDataset,
+    CLMView,
+    RandomShiftView,
+    Task,
+    TextDataModule,
+)
+from perceiver_io_tpu.data.text.preprocessor import TextPreprocessor
+from perceiver_io_tpu.data.text.streaming import (
+    C4DataModule,
+    StreamingTextPipeline,
+    shard_iterable,
+    window_shuffle,
+)
+from perceiver_io_tpu.data.text.sources import (
+    BookCorpusDataModule,
+    Enwik8DataModule,
+    ImdbDataModule,
+    ListDataModule,
+    WikipediaDataModule,
+    WikiTextDataModule,
+)
+from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer, HFTokenizer, load_tokenizer
+
+__all__ = [
+    "ByteTokenizer",
+    "BookCorpusDataModule",
+    "C4DataModule",
+    "StreamingTextPipeline",
+    "shard_iterable",
+    "window_shuffle",
+    "CLMView",
+    "ChunkedTokenDataset",
+    "DefaultCollator",
+    "Enwik8DataModule",
+    "HFTokenizer",
+    "ImdbDataModule",
+    "ListDataModule",
+    "RandomShiftView",
+    "RandomTruncateCollator",
+    "Task",
+    "TextDataModule",
+    "TextPreprocessor",
+    "TokenMaskingCollator",
+    "WikiTextDataModule",
+    "WikipediaDataModule",
+    "WordMaskingCollator",
+    "load_tokenizer",
+]

@@ -1,0 +1,135 @@
+"""Concrete text dataset modules (reference
+``perceiver/data/text/{wikitext,imdb,enwik8,bookcorpus,wikipedia}.py``): each
+only overrides :meth:`load_source_dataset`. Hub-backed sources import
+``datasets`` lazily so the package works fully offline; :class:`ListDataModule`
+feeds in-memory text (the test/offline path — the reference has no offline
+equivalent, its tests download real IMDb subsets, SURVEY.md §4)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from perceiver_io_tpu.data.text.datamodule import Task, TextDataModule
+
+
+class ListDataModule(TextDataModule):
+    """In-memory source: ``train_texts`` / ``valid_texts`` are lists of
+    strings, or (text, label) behavior via ``train_labels``/``valid_labels``."""
+
+    def __init__(
+        self,
+        train_texts: Sequence[str],
+        valid_texts: Sequence[str],
+        train_labels: Optional[Sequence[int]] = None,
+        valid_labels: Optional[Sequence[int]] = None,
+        num_classes: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self._train = (list(train_texts), list(train_labels) if train_labels else None)
+        self._valid = (list(valid_texts), list(valid_labels) if valid_labels else None)
+        self._num_classes = num_classes
+
+    @property
+    def num_classes(self) -> Optional[int]:
+        return self._num_classes
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        def pack(texts, labels):
+            return {"text": texts, "label": labels} if labels is not None else texts
+
+        return {"train": pack(*self._train), "valid": pack(*self._valid)}
+
+
+class _HubDataModule(TextDataModule):
+    """Shared plumbing for Hugging Face hub sources."""
+
+    def __init__(self, dataset_dir: Optional[str] = None, **kwargs):
+        super().__init__(dataset_dir=dataset_dir or os.path.join(".cache", self.cache_name), **kwargs)
+
+    cache_name = "hub"
+
+    def _load(self, path: str, name: Optional[str] = None, **kwargs):
+        from datasets import load_dataset
+
+        return load_dataset(path, name, cache_dir=self.dataset_dir, **kwargs)
+
+    @staticmethod
+    def _texts(split) -> List[str]:
+        return split["text"]
+
+
+class WikiTextDataModule(_HubDataModule):
+    """wikitext-103-raw (reference ``wikitext.py:10-20``)."""
+
+    cache_name = "wikitext"
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        ds = self._load("wikitext", "wikitext-103-raw-v1")
+        return {"train": self._texts(ds["train"]), "valid": self._texts(ds["validation"])}
+
+
+class ImdbDataModule(_HubDataModule):
+    """IMDb: clf uses train/test with labels; mlm/clm use unsupervised/test
+    text only (reference ``imdb.py:10-33``)."""
+
+    cache_name = "imdb"
+
+    @property
+    def num_classes(self) -> int:
+        return 2
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        ds = self._load("imdb", "plain_text")
+        if self.task == Task.clf:
+            return {
+                "train": {"text": ds["train"]["text"], "label": ds["train"]["label"]},
+                "valid": {"text": ds["test"]["text"], "label": ds["test"]["label"]},
+            }
+        return {"train": self._texts(ds["unsupervised"]), "valid": self._texts(ds["test"])}
+
+
+class Enwik8DataModule(_HubDataModule):
+    """enwik8 with a train/valid split and per-line trailing newline
+    (reference ``enwik8.py:10-37``)."""
+
+    cache_name = "enwik8"
+
+    def __init__(self, source_valid_size: float = 0.05, **kwargs):
+        self.source_valid_size = source_valid_size
+        super().__init__(**kwargs)
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        ds = self._load("enwik8", "enwik8", split="train")
+        texts = [t + "\n" for t in ds["text"]]
+        n_valid = int(len(texts) * self.source_valid_size)
+        return {"train": texts[: len(texts) - n_valid], "valid": texts[len(texts) - n_valid :]}
+
+
+class BookCorpusDataModule(_HubDataModule):
+    cache_name = "bookcorpus"
+
+    def __init__(self, source_valid_size: float = 0.05, **kwargs):
+        self.source_valid_size = source_valid_size
+        super().__init__(**kwargs)
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        ds = self._load("bookcorpus", split="train")
+        texts = self._texts(ds)
+        n_valid = int(len(texts) * self.source_valid_size)
+        return {"train": texts[: len(texts) - n_valid], "valid": texts[len(texts) - n_valid :]}
+
+
+class WikipediaDataModule(_HubDataModule):
+    cache_name = "wikipedia"
+
+    def __init__(self, config_name: str = "20220301.en", source_valid_size: float = 0.01, **kwargs):
+        self.config_name = config_name
+        self.source_valid_size = source_valid_size
+        super().__init__(**kwargs)
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        ds = self._load("wikipedia", self.config_name, split="train")
+        texts = self._texts(ds)
+        n_valid = int(len(texts) * self.source_valid_size)
+        return {"train": texts[: len(texts) - n_valid], "valid": texts[len(texts) - n_valid :]}

@@ -19,7 +19,8 @@ class MaskFiller:
 
     def __init__(self, preprocessor):
         self.preprocessor = preprocessor
-        self._jit_apply = None  # built once on first fill()
+        self._jit_apply = None  # cached per model instance
+        self._jit_model = None
 
     def fill(
         self,
@@ -35,10 +36,11 @@ class MaskFiller:
         xs, pad_mask = self.preprocessor.preprocess_batch(masked_text_batch)
         xs = np.asarray(xs)
 
-        if self._jit_apply is None:
+        if self._jit_apply is None or self._jit_model is not model:
             self._jit_apply = jax.jit(
                 lambda p, x, m: model.apply({"params": p}, x, pad_mask=m)
             )
+            self._jit_model = model
         logits = self._jit_apply(params, jnp.asarray(xs), jnp.asarray(pad_mask))
 
         pred_mask = xs == tokenizer.mask_token_id

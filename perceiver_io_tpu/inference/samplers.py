@@ -40,8 +40,9 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # mask tokens whose *preceding* cumulative mass already reached p
-    sorted_keep = (cum - probs) < p
+    # mask tokens whose *preceding* cumulative mass already reached p; the
+    # argmax always survives (even for p=0, matching HF's min-one-token rule)
+    sorted_keep = ((cum - probs) < p).at[..., 0].set(True)
     # threshold logit = smallest kept logit
     kth = jnp.min(jnp.where(sorted_keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
     return jnp.where(logits < kth, NEG_INF, logits)

@@ -65,14 +65,30 @@ def create_train_state(
     mesh: Mesh,
     *,
     min_fsdp_size: int = 2**14,
+    initial_params: Any = None,
 ) -> Tuple[TrainState, Any]:
     """Initialize a TrainState *directly sharded* on the mesh: params and
     optimizer state are materialized shard-by-shard under jit, so a model too
     big for one chip never exists unsharded (torch FSDP needs
     ``sync_module_states`` + meta-device tricks for the same effect).
 
+    :param initial_params: concrete warm-start params. These are device_put
+        onto the mesh and passed as a jit *argument* — closing over them would
+        bake the whole parameter set into the executable as constants.
     :return: (sharded TrainState, matching sharding pytree).
     """
+    if initial_params is not None:
+        shapes = jax.eval_shape(lambda p: TrainState.create(p, tx), initial_params)
+        shardings = state_shardings(shapes, mesh, min_fsdp_size=min_fsdp_size)
+        params = jax.device_put(initial_params, shardings.params)
+        with mesh:
+            state = jax.jit(
+                lambda p: TrainState.create(p, tx),
+                in_shardings=(shardings.params,),
+                out_shardings=shardings,
+            )(params)
+        return state, shardings
+
     def init_fn():
         return TrainState.create(init_params_fn(), tx)
 
@@ -91,8 +107,6 @@ def make_train_step(
     mesh: Mesh,
     shardings: TrainState,
     *,
-    batch_ndim: int = 2,
-    shard_seq: bool = False,
     grad_clip_norm: Optional[float] = None,
     donate: bool = True,
 ):
@@ -109,8 +123,6 @@ def make_train_step(
         committed sharding propagates; ``in_shardings`` pins only the state so
         heterogeneous batch pytrees — 2-D tokens, 4-D images — all work).
     """
-    del batch_ndim, shard_seq  # batch sharding comes from shard_batch placement
-
     def step(state: TrainState, batch, rng):
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, batch, rng

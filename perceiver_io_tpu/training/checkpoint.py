@@ -35,7 +35,7 @@ def save_pretrained(path: str, params: Any, config: Any, *, extra: Optional[dict
     """Write a self-describing model dir: orbax params + JSON config."""
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    meta = {"model_config": config_to_dict(config)}
+    meta = {"model_config": config_to_dict(config) if config is not None else None}
     if extra:
         meta.update(extra)
     with open(os.path.join(path, CONFIG_FILE), "w") as f:
@@ -48,7 +48,8 @@ def save_pretrained(path: str, params: Any, config: Any, *, extra: Optional[dict
 def load_config(path: str) -> Any:
     with open(os.path.join(os.path.abspath(path), CONFIG_FILE)) as f:
         meta = json.load(f)
-    return config_from_dict(None, meta["model_config"])
+    d = meta.get("model_config")
+    return config_from_dict(None, d) if d is not None else None
 
 
 def load_pretrained(path: str, *, target: Any = None):
@@ -95,7 +96,12 @@ class BestCheckpointManager:
 
     def save(self, step: int, params: Any, config: Any, val_loss: float) -> None:
         with open(os.path.join(self.directory, CONFIG_FILE), "w") as f:
-            json.dump({"model_config": config_to_dict(config)}, f, indent=2, default=str)
+            json.dump(
+                {"model_config": config_to_dict(config) if config is not None else None},
+                f,
+                indent=2,
+                default=str,
+            )
         self._manager.save(
             step,
             args=ocp.args.StandardSave(params),
@@ -113,8 +119,8 @@ class BestCheckpointManager:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         params = self._manager.restore(step, args=ocp.args.StandardRestore(target))
         with open(os.path.join(self.directory, CONFIG_FILE)) as f:
-            config = config_from_dict(None, json.load(f)["model_config"])
-        return params, config
+            d = json.load(f).get("model_config")
+        return params, (config_from_dict(None, d) if d is not None else None)
 
     def close(self):
         self._manager.close()

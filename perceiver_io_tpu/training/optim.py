@@ -49,7 +49,10 @@ def make_optimizer(
 
         def label(key_path, _):
             path = "/".join(str(getattr(k, "key", k)) for k in key_path)
-            return "frozen" if any(path.startswith(p) for p in frozen_prefixes) else "trainable"
+            frozen = any(
+                path == p or path.startswith(p + "/") for p in frozen_prefixes
+            )
+            return "frozen" if frozen else "trainable"
 
         return jax.tree_util.tree_map_with_path(label, params)
 

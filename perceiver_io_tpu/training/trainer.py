@@ -140,9 +140,10 @@ class Trainer:
         """
         cfg = self.config
         self.state, self._shardings = create_train_state(
-            init_params_fn if initial_params is None else (lambda: initial_params),
+            init_params_fn,
             self.tx,
             self.mesh,
+            initial_params=initial_params,
         )
         train_step = make_train_step(
             self.loss_fn,
@@ -173,7 +174,8 @@ class Trainer:
                 self.state, metrics = train_step(self.state, batch, step_rng)
                 window.append(metrics)
 
-                if step_idx % cfg.log_every_n_steps == 0:
+                def flush_window(step_idx=step_idx):
+                    nonlocal window, t0
                     mean = {
                         k: float(np.mean([float(m[k]) for m in window]))
                         for k in window[0]
@@ -184,18 +186,15 @@ class Trainer:
                     self.log_metrics(step_idx, mean, prefix="train/")
                     window, t0 = [], time.time()
 
+                if step_idx % cfg.log_every_n_steps == 0:
+                    flush_window()
+
                 if val_data is not None and step_idx % cfg.val_check_interval == 0:
                     if window:  # flush partial window so steps_per_sec stays honest
-                        mean = {
-                            k: float(np.mean([float(m[k]) for m in window]))
-                            for k in window[0]
-                        }
-                        mean["steps_per_sec"] = len(window) / (time.time() - t0)
-                        self.log_metrics(step_idx, mean, prefix="train/")
-                        window = []
+                        flush_window()
                     val_metrics = self.validate(val_data())
                     self.log_metrics(step_idx, val_metrics, prefix="val/")
-                    if self._ckpt is not None:
+                    if self._ckpt is not None and "loss" in val_metrics:
                         self._ckpt.save(
                             step_idx,
                             self.state.params,
