@@ -23,6 +23,7 @@ from perceiver_io_tpu.parallel.partition import (
     shard_batch,
     shard_params,
 )
+from perceiver_io_tpu.parallel.ring import ring_attention, ring_attention_sharded
 from perceiver_io_tpu.parallel.train_step import (
     TrainState,
     create_train_state,
@@ -39,6 +40,8 @@ __all__ = [
     "param_shardings",
     "shard_batch",
     "shard_params",
+    "ring_attention",
+    "ring_attention_sharded",
     "TrainState",
     "create_train_state",
     "make_eval_step",
