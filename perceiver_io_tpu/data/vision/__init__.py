@@ -1,0 +1,22 @@
+"""Vision data layer (host-side NumPy) — capability surface of the
+reference's ``perceiver/data/vision/`` package (SURVEY.md §2.3): image
+preprocessing + MNIST datamodule for classifier training, and the optical
+flow patch/blend/render processor feeding the optical-flow pipeline.
+"""
+from perceiver_io_tpu.data.vision.image import (
+    ImagePreprocessor,
+    MNISTDataModule,
+    random_crop_and_flip,
+)
+from perceiver_io_tpu.data.vision.optical_flow import (
+    OpticalFlowProcessor,
+    render_optical_flow,
+)
+
+__all__ = [
+    "ImagePreprocessor",
+    "MNISTDataModule",
+    "random_crop_and_flip",
+    "OpticalFlowProcessor",
+    "render_optical_flow",
+]

@@ -1,0 +1,177 @@
+"""Image preprocessing + MNIST datamodule.
+
+Capability parity with the reference's vision data package
+(``perceiver/data/vision/mnist.py:17-96``, ``common.py``): channels-last
+uint8 → normalized float32, optional train-time augmentation, and a
+datamodule yielding ``{"image": (b, h, w, c) f32, "label": (b,) i32}``
+batches — the input contract of
+:class:`perceiver_io_tpu.models.vision.image_classifier.ImageClassifier`.
+
+TPU-first notes: everything is NumPy on the host; batches have static shapes
+(drop_last always) so the jitted train step compiles once. Normalization is
+folded into the collator rather than a per-sample transform pipeline —
+vectorized over the batch instead of Python-per-example as in torchvision
+transforms.
+
+Dataset sourcing: `load_arrays()` pulls MNIST from a local HF datasets cache
+when available; `from_arrays(...)` injects arrays directly (tests, custom
+datasets) — the reference's torchvision download path has no offline
+equivalent.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from perceiver_io_tpu.data.loader import DataLoader
+
+# Reference normalization (perceiver/data/vision/mnist.py:28-31): mean/std of
+# MNIST pixels in [0, 1].
+MNIST_MEAN = 0.1307
+MNIST_STD = 0.3081
+
+
+def random_crop_and_flip(
+    images: np.ndarray,
+    rng: np.random.Generator,
+    *,
+    pad: int = 2,
+    flip: bool = False,
+) -> np.ndarray:
+    """Batched random-shift crop (zero-pad by ``pad`` then crop back) and
+    optional horizontal flip — the standard small-image augmentation
+    (reference uses RandomCrop via torchvision, ``mnist.py:33-39``)."""
+    b, h, w, c = images.shape
+    padded = np.zeros((b, h + 2 * pad, w + 2 * pad, c), images.dtype)
+    padded[:, pad : pad + h, pad : pad + w] = images
+    ys = rng.integers(0, 2 * pad + 1, size=b)
+    xs = rng.integers(0, 2 * pad + 1, size=b)
+    out = np.empty_like(images)
+    for idx in range(b):  # b is a host batch; cost is negligible vs the step
+        out[idx] = padded[idx, ys[idx] : ys[idx] + h, xs[idx] : xs[idx] + w]
+    if flip:
+        do_flip = rng.random(b) < 0.5
+        out[do_flip] = out[do_flip, :, ::-1]
+    return out
+
+
+class ImagePreprocessor:
+    """uint8 channels-last image → normalized float32 model input
+    (single-image inference entry, reference ``perceiver/data/vision/common.py``)."""
+
+    def __init__(self, mean: float = MNIST_MEAN, std: float = MNIST_STD):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, images: np.ndarray) -> np.ndarray:
+        x = np.asarray(images)
+        if x.ndim == 2:  # single grayscale image
+            x = x[None, :, :, None]
+        elif x.ndim == 3 and x.shape[-1] in (1, 3):  # single image
+            x = x[None]
+        elif x.ndim == 3:  # batch of grayscale
+            x = x[..., None]
+        x = x.astype(np.float32) / 255.0
+        return (x - self.mean) / self.std
+
+
+class _ImageDataset:
+    def __init__(self, images: np.ndarray, labels: np.ndarray):
+        assert len(images) == len(labels)
+        self.images = images
+        self.labels = labels
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx: int) -> Dict:
+        return {"image": self.images[idx], "label": self.labels[idx]}
+
+
+class MNISTDataModule:
+    """MNIST datamodule: 28×28×1 channels-last, normalized, shuffled static
+    batches (reference ``perceiver/data/vision/mnist.py:17-96``).
+
+    :param augment: random-shift crop on the train split.
+    """
+
+    image_shape: Tuple[int, int, int] = (28, 28, 1)
+    num_classes: int = 10
+
+    def __init__(
+        self,
+        batch_size: int = 64,
+        *,
+        augment: bool = True,
+        seed: int = 0,
+        shard_index: Optional[int] = None,
+        shard_count: Optional[int] = None,
+    ):
+        self.batch_size = batch_size
+        self.augment = augment
+        self.seed = seed
+        self.shard_index = shard_index
+        self.shard_count = shard_count
+        self.preprocessor = ImagePreprocessor()
+        self._splits: Dict[str, _ImageDataset] = {}
+
+    # -- sourcing ----------------------------------------------------------
+    @classmethod
+    def from_arrays(
+        cls,
+        train: Tuple[np.ndarray, np.ndarray],
+        valid: Tuple[np.ndarray, np.ndarray],
+        **kwargs,
+    ) -> "MNISTDataModule":
+        dm = cls(**kwargs)
+        dm._splits = {
+            "train": _ImageDataset(*train),
+            "valid": _ImageDataset(*valid),
+        }
+        return dm
+
+    def load_arrays(self) -> None:
+        """Load MNIST from the local HF datasets cache."""
+        import datasets
+
+        ds = datasets.load_dataset("mnist")
+        for split, name in (("train", "train"), ("valid", "test")):
+            imgs = np.stack([np.asarray(im) for im in ds[name]["image"]])[..., None]
+            labels = np.asarray(ds[name]["label"], np.int64)
+            self._splits[split] = _ImageDataset(imgs, labels)
+
+    def setup(self) -> None:
+        if not self._splits:
+            self.load_arrays()
+
+    # -- loaders -----------------------------------------------------------
+    def _collate(self, train: bool):
+        aug_rng = np.random.default_rng(self.seed + 1)
+
+        def collate(examples):
+            images = np.stack([e["image"] for e in examples]).astype(np.uint8)
+            labels = np.asarray([e["label"] for e in examples], np.int32)
+            if train and self.augment:
+                images = random_crop_and_flip(images, aug_rng)
+            return {"image": self.preprocessor(images), "label": labels}
+
+        return collate
+
+    def _loader(self, split: str, shuffle: bool) -> DataLoader:
+        return DataLoader(
+            self._splits[split],
+            batch_size=self.batch_size,
+            shuffle=shuffle,
+            drop_last=True,
+            collate_fn=self._collate(train=shuffle),
+            seed=self.seed,
+            shard_index=self.shard_index,
+            shard_count=self.shard_count,
+        )
+
+    def train_dataloader(self) -> DataLoader:
+        return self._loader("train", shuffle=True)
+
+    def val_dataloader(self) -> DataLoader:
+        return self._loader("valid", shuffle=False)

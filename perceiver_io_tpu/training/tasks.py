@@ -78,6 +78,22 @@ def mlm_loss_fn(model) -> Callable:
     return loss_fn
 
 
+def image_classifier_loss_fn(model) -> Callable:
+    """Image classifier step over ``{"image", "label"}`` batches (the vision
+    datamodule contract; reference ``image_classifier/lightning.py:12-41``)."""
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            {"params": params}, batch["image"], deterministic=rng is None
+        )
+        labels = batch["label"]
+        loss = masked_cross_entropy(logits, labels)
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
 def classifier_loss_fn(model) -> Callable:
     """Classifier step: CE + accuracy (reference
     ``perceiver/model/core/lightning.py:50-76``; accuracy reduction across

@@ -22,6 +22,10 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: multi-second integration test")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

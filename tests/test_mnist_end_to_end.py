@@ -1,0 +1,84 @@
+"""End-to-end slice (SURVEY.md §7): MNIST datamodule → ImageClassifier →
+Trainer on the dp mesh. A tiny model on a learnable synthetic task must
+beat chance after a few hundred steps."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+from perceiver_io_tpu.data.vision import MNISTDataModule
+from perceiver_io_tpu.models.core.config import (
+    ClassificationDecoderConfig,
+    PerceiverIOConfig,
+)
+from perceiver_io_tpu.models.vision.image_classifier import (
+    ImageClassifier,
+    ImageEncoderConfig,
+)
+from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+from perceiver_io_tpu.training.tasks import image_classifier_loss_fn
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+
+def _synthetic_mnist(n, seed=0):
+    """Labels recoverable from the image: brightness of one corner patch."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 4, n).astype(np.int64)
+    imgs = rng.integers(0, 64, (n, 28, 28, 1), dtype=np.uint8)
+    for i, lab in enumerate(labels):
+        y, x = divmod(int(lab), 2)
+        imgs[i, 14 * y : 14 * y + 14, 14 * x : 14 * x + 14] += 120
+    return imgs, labels
+
+
+@pytest.mark.slow
+def test_mnist_slice_learns(tmp_path):
+    dm = MNISTDataModule.from_arrays(
+        _synthetic_mnist(256), _synthetic_mnist(64, seed=1),
+        batch_size=32, augment=False,
+    )
+    dm.setup()
+
+    cfg = PerceiverIOConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(28, 28, 1),
+            num_frequency_bands=4,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=2,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=4, num_output_query_channels=16, num_cross_attention_heads=2
+        ),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model = ImageClassifier(config=cfg)
+
+    mesh = make_mesh(MeshConfig(data=8))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=120,
+            val_check_interval=120,
+            log_every_n_steps=60,
+            default_root_dir=str(tmp_path),
+            enable_checkpointing=False,
+            enable_tensorboard=False,
+        ),
+        mesh,
+        image_classifier_loss_fn(model),
+        optax.adam(3e-3),
+        model_config=cfg,
+    )
+
+    import jax
+
+    def init_params():
+        batch = next(iter(dm.train_dataloader()))
+        return model.init(jax.random.PRNGKey(0), jnp.asarray(batch["image"]))["params"]
+
+    trainer.fit(init_params, dm.train_dataloader(), val_data=dm.val_dataloader)
+    val = trainer.validate(dm.val_dataloader())
+    trainer.close()
+    assert val["accuracy"] > 0.5, f"chance is 0.25, got {val}"
