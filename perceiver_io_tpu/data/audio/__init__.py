@@ -1,0 +1,41 @@
+"""Audio data layer (host-side NumPy) — capability surface of the
+reference's ``perceiver/data/audio/`` package (SURVEY.md §2.3): the MIDI
+event codec and the symbolic-audio datamodule feeding Perceiver AR training.
+"""
+from perceiver_io_tpu.data.audio.midi import (
+    PAD_TOKEN,
+    SEPARATOR,
+    VOCAB_SIZE,
+    ControlChange,
+    Note,
+    decode_to_midi_file,
+    encode_midi_file,
+    encode_midi_files,
+    events_from_notes,
+    notes_from_events,
+)
+from perceiver_io_tpu.data.audio.symbolic import (
+    GiantMidiPianoDataModule,
+    MaestroV3DataModule,
+    SymbolicAudioCollator,
+    SymbolicAudioDataModule,
+    SymbolicAudioDataset,
+)
+
+__all__ = [
+    "PAD_TOKEN",
+    "SEPARATOR",
+    "VOCAB_SIZE",
+    "Note",
+    "ControlChange",
+    "events_from_notes",
+    "notes_from_events",
+    "encode_midi_file",
+    "encode_midi_files",
+    "decode_to_midi_file",
+    "SymbolicAudioCollator",
+    "SymbolicAudioDataModule",
+    "SymbolicAudioDataset",
+    "MaestroV3DataModule",
+    "GiantMidiPianoDataModule",
+]

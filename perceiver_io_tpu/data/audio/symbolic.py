@@ -1,0 +1,253 @@
+"""Symbolic-audio datamodule: MIDI dirs → flat int16 token stream →
+random-window samples → static left/right-padded shift-by-one batches.
+
+Behavioral parity with the reference (``perceiver/data/audio/symbolic.py:16-232``):
+
+- **storage**: every encoded piece is appended to one flat ``int16`` array
+  with ``-1`` separators between pieces, saved as ``train.bin``/``valid.bin``
+  memmaps — O(1) random access into the token stream.
+- **sampling**: a sample is a random window of ``max_seq_len + 1`` tokens;
+  if it crosses piece boundaries, the longest separator-free span is kept;
+  with ``min_seq_len`` set, the span is further truncated to a random length
+  (the AR curriculum over sequence lengths, reference ``symbolic.py:161-191``).
+- **collation**: pad to ``max_seq_len + 1`` on the configured side, then emit
+  the shift-by-one ``{"labels": x[1:], "input_ids": x[:-1], "pad_mask"}``
+  dict — static shapes, one XLA compilation.
+
+TPU-first deltas: sampling uses a per-epoch seeded generator (reproducible
+across restarts; the reference draws from the global torch RNG), and batches
+are NumPy dicts for ``device_put`` straight into the sharded train step.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from perceiver_io_tpu.data.audio.midi import (
+    PAD_TOKEN,
+    SEPARATOR,
+    VOCAB_SIZE,
+    encode_midi_files,
+)
+from perceiver_io_tpu.data.loader import DataLoader
+from perceiver_io_tpu.data.text.collators import IGNORE_INDEX
+
+
+class SymbolicAudioDataset:
+    """Random windows over the flat separator-delimited token stream."""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        max_seq_len: int,
+        *,
+        min_seq_len: Optional[int] = None,
+        seed: int = 0,
+    ):
+        if data.shape[0] <= max_seq_len + 1:
+            raise ValueError(
+                f"token stream of {data.shape[0]} tokens is too short for "
+                f"max_seq_len={max_seq_len}"
+            )
+        self._data = data
+        self._window = max_seq_len + 1  # +1 for the shift-by-one view
+        self._min_window = min_seq_len + 1 if min_seq_len is not None else None
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._data.shape[0] // self._window
+
+    def __getitem__(self, index) -> Dict:
+        start = int(self._rng.integers(0, self._data.shape[0] - self._window))
+        sample = np.asarray(self._data[start : start + self._window], np.int64)
+
+        if (sample == SEPARATOR).any():
+            # longest separator-free span (reference symbolic.py:173-183)
+            bounds = np.flatnonzero(sample == SEPARATOR)
+            edges = np.concatenate([[-1], bounds, [len(sample)]])
+            spans = [
+                sample[edges[i] + 1 : edges[i + 1]]
+                for i in range(len(edges) - 1)
+            ]
+            sample = max(spans, key=len)
+
+        if self._min_window is not None and self._min_window < len(sample):
+            length = int(self._rng.integers(self._min_window, self._window))
+            sample = sample[:length]
+        return {"input_ids": sample}
+
+
+class SymbolicAudioCollator:
+    """Pad to ``max_seq_len + 1``, emit shift-by-one dict (reference
+    ``symbolic.py:194-232``; pad labels become ``IGNORE_INDEX`` so the loss
+    mask needs no separate plumbing)."""
+
+    def __init__(self, max_seq_len: int, padding_side: str = "left"):
+        if padding_side not in ("left", "right"):
+            raise ValueError(f"invalid padding side '{padding_side}'")
+        self._width = max_seq_len + 1
+        self._side = padding_side
+
+    def __call__(self, examples: Sequence[Dict]) -> Dict[str, np.ndarray]:
+        rows = np.full((len(examples), self._width), PAD_TOKEN, np.int32)
+        for r, example in enumerate(examples):
+            ids = example["input_ids"][: self._width]
+            if self._side == "left":
+                rows[r, self._width - len(ids) :] = ids
+            else:
+                rows[r, : len(ids)] = ids
+        input_ids = rows[:, :-1]
+        labels = rows[:, 1:].astype(np.int32)
+        pad_mask = input_ids == PAD_TOKEN
+        labels = np.where(labels == PAD_TOKEN, IGNORE_INDEX, labels)
+        return {"labels": labels, "input_ids": input_ids, "pad_mask": pad_mask}
+
+
+class SymbolicAudioDataModule:
+    """Reference ``SymbolicAudioDataModule`` (``symbolic.py:16-157``).
+
+    Subclasses (or callers) provide the source MIDI directories via
+    :meth:`load_source_dataset`; :meth:`from_token_streams` injects already
+    encoded streams (tests, custom corpora).
+    """
+
+    vocab_size: int = VOCAB_SIZE
+
+    def __init__(
+        self,
+        dataset_dir: str,
+        max_seq_len: int,
+        *,
+        min_seq_len: Optional[int] = None,
+        padding_side: str = "left",
+        batch_size: int = 16,
+        preproc_workers: int = 1,
+        seed: int = 0,
+        shard_index: Optional[int] = None,
+        shard_count: Optional[int] = None,
+    ):
+        if min_seq_len is not None and not 0 < min_seq_len < max_seq_len:
+            raise ValueError("need 0 < min_seq_len < max_seq_len")
+        self.dataset_dir = Path(dataset_dir)
+        self.max_seq_len = max_seq_len
+        self.min_seq_len = min_seq_len
+        self.padding_side = padding_side
+        self.batch_size = batch_size
+        self.preproc_workers = preproc_workers
+        self.seed = seed
+        self.shard_index = shard_index
+        self.shard_count = shard_count
+        self._splits: Dict[str, np.ndarray] = {}
+
+    # -- sourcing ----------------------------------------------------------
+    @property
+    def preproc_dir(self) -> Path:
+        return self.dataset_dir / "preproc"
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        """Return ``{"train": dir, "valid": dir}`` of MIDI directories."""
+        raise NotImplementedError
+
+    @classmethod
+    def from_token_streams(
+        cls, train: np.ndarray, valid: np.ndarray, max_seq_len: int, **kwargs
+    ) -> "SymbolicAudioDataModule":
+        dm = cls(dataset_dir=".", max_seq_len=max_seq_len, **kwargs)
+        dm._splits = {
+            "train": np.asarray(train, np.int16),
+            "valid": np.asarray(valid, np.int16),
+        }
+        return dm
+
+    @staticmethod
+    def flatten_pieces(pieces: List[np.ndarray], shuffle_seed: Optional[int] = None) -> np.ndarray:
+        """Concatenate encoded pieces with separators (reference
+        ``symbolic.py:117-118``)."""
+        if shuffle_seed is not None:
+            order = np.random.default_rng(shuffle_seed).permutation(len(pieces))
+            pieces = [pieces[i] for i in order]
+        parts = [np.append(p.astype(np.int16), np.int16(SEPARATOR)) for p in pieces]
+        return np.concatenate(parts)
+
+    def prepare_data(self) -> None:
+        if self._splits or self.preproc_dir.exists():
+            return
+        sources = self.load_source_dataset()
+        os.makedirs(self.preproc_dir)
+        for split in ("train", "valid"):
+            midi_dir = Path(sources[split])
+            files = sorted(midi_dir.rglob("**/*.mid")) + sorted(midi_dir.rglob("**/*.midi"))
+            pieces = encode_midi_files(files, num_workers=self.preproc_workers)
+            flat = self.flatten_pieces(
+                pieces, shuffle_seed=self.seed if split == "train" else None
+            )
+            fp = np.memmap(
+                self.preproc_dir / f"{split}.bin", np.int16, mode="w+", shape=flat.shape
+            )
+            fp[:] = flat
+            fp.flush()
+
+    def setup(self) -> None:
+        if self._splits:
+            return
+        self._splits = {
+            split: np.memmap(self.preproc_dir / f"{split}.bin", np.int16, mode="r")
+            for split in ("train", "valid")
+        }
+
+    # -- loaders -----------------------------------------------------------
+    def _loader(self, split: str, min_seq_len: Optional[int]) -> DataLoader:
+        dataset = SymbolicAudioDataset(
+            self._splits[split],
+            self.max_seq_len,
+            min_seq_len=min_seq_len,
+            seed=self.seed,
+        )
+        return DataLoader(
+            dataset,
+            batch_size=self.batch_size,
+            shuffle=False,  # samples are already random windows
+            drop_last=True,
+            collate_fn=SymbolicAudioCollator(self.max_seq_len, self.padding_side),
+            seed=self.seed,
+            shard_index=self.shard_index,
+            shard_count=self.shard_count,
+        )
+
+    def train_dataloader(self) -> DataLoader:
+        return self._loader("train", self.min_seq_len)
+
+    def val_dataloader(self) -> DataLoader:
+        # validation always uses full windows (reference symbolic.py:133-137)
+        return self._loader("valid", None)
+
+
+class MaestroV3DataModule(SymbolicAudioDataModule):
+    """MAESTRO v3 piano corpus (reference ``maestro_v3.py``): expects the
+    extracted archive at ``<dataset_dir>/maestro-v3.0.0`` (zero-egress images
+    cannot download; point ``dataset_dir`` at a local copy)."""
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        root = self.dataset_dir / "maestro-v3.0.0"
+        if not root.exists():
+            raise FileNotFoundError(
+                f"{root} not found — place the extracted MAESTRO v3 archive there"
+            )
+        return {"train": root, "valid": root}
+
+
+class GiantMidiPianoDataModule(SymbolicAudioDataModule):
+    """GiantMIDI-Piano corpus (reference ``giantmidi_piano.py``): expects
+    ``<dataset_dir>/midis`` with a train/valid split by trailing filename
+    digit (valid = hash bucket 0)."""
+
+    valid_bucket: int = 0
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        root = self.dataset_dir / "midis"
+        if not root.exists():
+            raise FileNotFoundError(f"{root} not found — place GiantMIDI midis there")
+        return {"train": root, "valid": root}

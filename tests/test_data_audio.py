@@ -1,0 +1,160 @@
+"""Audio data layer: MIDI event codec round-trips and the symbolic
+datamodule's sampling/collation semantics (reference behavior per
+``perceiver/data/audio/midi_processor.py`` and ``symbolic.py``)."""
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.audio import (
+    PAD_TOKEN,
+    SEPARATOR,
+    VOCAB_SIZE,
+    ControlChange,
+    Note,
+    SymbolicAudioCollator,
+    SymbolicAudioDataModule,
+    SymbolicAudioDataset,
+    events_from_notes,
+    notes_from_events,
+)
+from perceiver_io_tpu.data.audio.midi import (
+    NOTE_OFF_OFFSET,
+    TIME_SHIFT_OFFSET,
+    VELOCITY_OFFSET,
+)
+from perceiver_io_tpu.data.text.collators import IGNORE_INDEX
+
+
+# -- codec ----------------------------------------------------------------
+def test_vocab_constants():
+    assert VOCAB_SIZE == 389 and PAD_TOKEN == 388 and SEPARATOR == -1
+    assert NOTE_OFF_OFFSET == 128 and TIME_SHIFT_OFFSET == 256 and VELOCITY_OFFSET == 356
+
+
+def test_simple_encode():
+    notes = [Note(pitch=60, velocity=80, start=0.0, end=0.5)]
+    events = events_from_notes(notes)
+    # velocity bucket 20, note_on 60, time shift 0.5s (value 49), note_off 60
+    assert events == [VELOCITY_OFFSET + 20, 60, TIME_SHIFT_OFFSET + 49, NOTE_OFF_OFFSET + 60]
+
+
+def test_round_trip_notes():
+    rng = np.random.default_rng(0)
+    notes = []
+    t = 0.0
+    for i in range(50):
+        t += float(rng.uniform(0.01, 0.3))
+        notes.append(
+            Note(
+                # unique pitches: overlapping same-pitch notes are inherently
+                # ambiguous in the event encoding (last-on wins on decode,
+                # same as the reference's note_on_dict)
+                pitch=21 + i,
+                velocity=int(rng.integers(1, 128)) // 4 * 4,  # bucket-aligned
+                start=round(t, 2),
+                end=round(t + float(rng.uniform(0.05, 2.0)), 2),
+            )
+        )
+    decoded = notes_from_events(events_from_notes(notes))
+    assert len(decoded) == len(notes)
+    for orig, dec in zip(sorted(notes, key=lambda n: (n.start, n.pitch)), decoded):
+        assert dec.pitch == orig.pitch
+        assert abs(dec.start - orig.start) < 0.011
+        assert abs(dec.end - orig.end) < 0.011
+        assert dec.velocity == orig.velocity
+
+
+def test_long_gap_emits_repeated_shifts():
+    notes = [Note(60, 80, 0.0, 2.5)]
+    events = events_from_notes(notes)
+    # 2.5s gap between on and off: two max shifts (1s) + one 0.5s shift
+    shifts = [e for e in events if TIME_SHIFT_OFFSET <= e < VELOCITY_OFFSET]
+    assert shifts == [TIME_SHIFT_OFFSET + 99, TIME_SHIFT_OFFSET + 99, TIME_SHIFT_OFFSET + 49]
+
+
+def test_velocity_change_only_when_bucket_changes():
+    notes = [
+        Note(60, 80, 0.0, 0.1),
+        Note(62, 81, 0.2, 0.3),  # same bucket (20) -> no velocity event
+        Note(64, 100, 0.4, 0.5),  # bucket 25 -> velocity event
+    ]
+    events = events_from_notes(notes)
+    vel_events = [e for e in events if e >= VELOCITY_OFFSET]
+    assert vel_events == [VELOCITY_OFFSET + 20, VELOCITY_OFFSET + 25]
+
+
+def test_sustain_extends_notes():
+    # pedal down before note ends: note-off deferred to pedal release
+    notes = [Note(60, 80, 0.1, 0.3)]
+    controls = [ControlChange(64, 100, 0.0), ControlChange(64, 0, 1.0)]
+    decoded = notes_from_events(events_from_notes(notes, controls))
+    assert len(decoded) == 1
+    assert abs(decoded[0].end - 1.0) < 0.011
+    # next same-pitch note cuts the sustained one
+    notes = [Note(60, 80, 0.1, 0.3), Note(60, 80, 0.6, 0.7)]
+    decoded = notes_from_events(events_from_notes(notes, controls))
+    assert abs(decoded[0].end - 0.6) < 0.011
+
+
+def test_unmatched_note_off_dropped():
+    assert notes_from_events([NOTE_OFF_OFFSET + 60]) == []
+    assert notes_from_events([60]) == []  # never closed -> dropped
+
+
+# -- dataset / collator ---------------------------------------------------
+def _stream(pieces, rng=None):
+    return SymbolicAudioDataModule.flatten_pieces(
+        [np.asarray(p, np.int16) for p in pieces]
+    )
+
+
+def test_dataset_picks_longest_span():
+    # stream with separators; windows crossing a boundary keep longest span
+    pieces = [np.arange(5), np.arange(100, 160), np.arange(200, 203)]
+    data = _stream(pieces)
+    ds = SymbolicAudioDataset(data, max_seq_len=20, seed=0)
+    for _ in range(20):
+        sample = ds[0]["input_ids"]
+        assert SEPARATOR not in sample
+        assert len(sample) <= 21
+
+
+def test_dataset_min_seq_len():
+    data = _stream([np.arange(300)])
+    ds = SymbolicAudioDataset(data, max_seq_len=40, min_seq_len=10, seed=0)
+    lengths = {len(ds[0]["input_ids"]) for _ in range(50)}
+    assert all(11 <= n <= 41 for n in lengths)
+    assert len(lengths) > 5  # actually random
+
+
+def test_collator_left_pad_shift_by_one():
+    coll = SymbolicAudioCollator(max_seq_len=8, padding_side="left")
+    batch = coll([{"input_ids": np.arange(1, 6)}])  # 5 tokens, width 9
+    assert batch["input_ids"].shape == (1, 8)
+    np.testing.assert_array_equal(batch["input_ids"][0, -4:], [1, 2, 3, 4])
+    np.testing.assert_array_equal(batch["labels"][0, -5:], [1, 2, 3, 4, 5])
+    assert batch["pad_mask"][0, :4].all() and not batch["pad_mask"][0, 4:].any()
+    # shift-by-one: 4 input pads but only 3 label pads
+    assert (batch["labels"][0, :3] == IGNORE_INDEX).all()
+
+
+def test_collator_right_pad():
+    coll = SymbolicAudioCollator(max_seq_len=8, padding_side="right")
+    batch = coll([{"input_ids": np.arange(1, 6)}])
+    np.testing.assert_array_equal(batch["input_ids"][0, :5], [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(batch["labels"][0, :4], [2, 3, 4, 5])
+    assert (batch["labels"][0, 4:] == IGNORE_INDEX).all()
+
+
+def test_datamodule_from_streams_batches():
+    rng = np.random.default_rng(0)
+    train = _stream([rng.integers(0, 388, 400) for _ in range(3)])
+    valid = _stream([rng.integers(0, 388, 200)])
+    dm = SymbolicAudioDataModule.from_token_streams(
+        train, valid, max_seq_len=32, batch_size=4
+    )
+    dm.setup()
+    batch = next(iter(dm.train_dataloader()))
+    assert batch["input_ids"].shape == (4, 32)
+    assert batch["labels"].shape == (4, 32)
+    assert batch["pad_mask"].dtype == bool
+    assert batch["input_ids"].max() < VOCAB_SIZE
