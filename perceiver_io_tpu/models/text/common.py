@@ -24,6 +24,7 @@ class TextEncoderConfig(EncoderConfig):
     max_seq_len: int = 256
     num_input_channels: int = 64
     params: Optional[str] = None
+    freeze: bool = False
 
 
 class TextInputAdapter(InputAdapter):

@@ -1,0 +1,3 @@
+"""Training CLI layer — the reference's ``perceiver/scripts/`` surface
+(SURVEY.md §2.4) rebuilt on the dataclass CLI engine in
+:mod:`perceiver_io_tpu.scripts.cli`."""

@@ -1,0 +1,61 @@
+"""Symbolic audio model CLI (reference ``perceiver/scripts/audio/symbolic.py``):
+
+    python -m perceiver_io_tpu.scripts.audio.symbolic fit --data=maestro \
+        --data.dataset_dir=.cache/maestro --trainer.max_steps=10000
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from perceiver_io_tpu.data.audio import (
+    GiantMidiPianoDataModule,
+    MaestroV3DataModule,
+    SymbolicAudioDataModule,
+)
+from perceiver_io_tpu.models.audio.symbolic import (
+    SymbolicAudioModel,
+    SymbolicAudioModelConfig,
+)
+from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
+from perceiver_io_tpu.training.tasks import clm_loss_fn
+
+DATA = {
+    "maestro": MaestroV3DataModule,
+    "giantmidi": GiantMidiPianoDataModule,
+    "symbolic": SymbolicAudioDataModule,
+}
+
+
+def _link(dm, values):
+    values.setdefault("model.vocab_size", dm.vocab_size)
+    values.setdefault("model.max_seq_len", dm.max_seq_len)
+
+
+FAMILY = ModelFamily(
+    name="perceiver_io_tpu.scripts.audio.symbolic",
+    config_class=SymbolicAudioModelConfig,
+    data_registry=DATA,
+    build_model=lambda cfg, dm: SymbolicAudioModel(cfg, dtype=jnp.bfloat16),
+    make_loss=lambda model, cfg: clm_loss_fn(model, cfg.max_latents),
+    init_args=lambda cfg, batch: (
+        (jnp.asarray(batch["input_ids"][:1]), cfg.max_seq_len - cfg.max_latents),
+        {},
+    ),
+    link=_link,
+    # Paper config (reference ``audio/symbolic.py:9-29``): GiantMIDI model,
+    # 6144 ctx / 2048 latents when trained at full scale.
+    defaults={
+        "model.max_latents": 2048,
+        "model.num_channels": 768,
+        "lr_scheduler.name": "cosine",
+        "lr_scheduler.warmup_steps": 500,
+    },
+)
+
+
+def main(argv=None):
+    return CLI(FAMILY).main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,384 @@
+"""Config-driven training CLI — the reference's LightningCLI surface
+(``perceiver/scripts/cli.py:13-48``) without Lightning/jsonargparse:
+
+    python -m perceiver_io_tpu.scripts.text.clm fit \
+        --data=wikitext --data.max_seq_len=4096 \
+        --model.num_latents=512 --optimizer.lr=2e-4 \
+        --trainer.max_steps=10000 --trainer.default_root_dir=logs
+
+Flags are generated from dataclass fields (``--model.*`` from the family's
+model config, ``--data.*`` from the datamodule constructor, ``--trainer.*``
+from :class:`~perceiver_io_tpu.training.trainer.TrainerConfig`, plus
+``--optimizer.*`` / ``--lr_scheduler.*``). ``--config file.yaml`` loads
+defaults (CLI flags win), mirroring the reference's ``trainer.yaml`` default
+config file; ``link`` functions propagate data-derived values into the model
+config (``link_arguments`` parity, e.g. vocab_size — reference
+``scripts/text/mlm.py:12-16``). Subcommands: ``fit``, ``validate``,
+``preproc``.
+
+Model-family entry points are declarative :class:`ModelFamily` records; see
+``perceiver_io_tpu/scripts/text/clm.py`` for the pattern.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import sys
+import typing
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+# -- dataclass <-> flags ---------------------------------------------------
+def _unwrap_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0], True
+    return tp, False
+
+
+def _parse_value(text: str, tp) -> Any:
+    tp, optional = _unwrap_optional(tp)
+    if optional and text.lower() in ("none", "null"):
+        return None
+    origin = typing.get_origin(tp)
+    if tp is bool:
+        if text.lower() in ("true", "1", "yes"):
+            return True
+        if text.lower() in ("false", "0", "no"):
+            return False
+        raise ValueError(f"invalid bool {text!r}")
+    if tp in (int, float, str):
+        return tp(text)
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp[text]
+    if origin in (tuple, list):
+        elem = (typing.get_args(tp) or (str,))[0]
+        if elem is Ellipsis:
+            elem = str
+        items = [t for t in text.replace("(", "").replace(")", "").split(",") if t != ""]
+        seq = [_parse_value(t.strip(), elem) for t in items]
+        return tuple(seq) if origin is tuple else seq
+    # fall back to python literal-ish string
+    return text
+
+
+def _coerce(value: Any, tp) -> Any:
+    """Coerce a YAML-loaded value to the field type."""
+    if isinstance(value, str):
+        return _parse_value(value, tp)
+    tp2, _ = _unwrap_optional(tp)
+    if value is not None and typing.get_origin(tp2) is tuple:
+        elem = (typing.get_args(tp2) or (str,))[0]
+        return tuple(value)
+    if value is not None and isinstance(tp2, type) and issubclass(tp2, enum.Enum) and not isinstance(value, tp2):
+        return tp2[value]
+    return value
+
+
+def flag_specs(cls, prefix: str, nested: Optional[Dict[str, type]] = None) -> Dict[str, Any]:
+    """``{dotted_flag: type}`` for a dataclass, recursing into nested
+    dataclass fields (``nested`` overrides TypeVar-typed fields with
+    concrete classes — PerceiverIOConfig is Generic[E, D])."""
+    nested = nested or {}
+    specs: Dict[str, Any] = {}
+    cls = typing.get_origin(cls) or cls  # unwrap PerceiverIOConfig[E, D]
+    hints = typing.get_type_hints(cls)
+    for field in dataclasses.fields(cls):
+        tp = nested.get(field.name, hints.get(field.name, str))
+        if dataclasses.is_dataclass(tp):
+            specs.update(flag_specs(tp, f"{prefix}.{field.name}"))
+        else:
+            specs[f"{prefix}.{field.name}"] = tp
+    return specs
+
+
+def build_dataclass(cls, values: Dict[str, Any], prefix: str,
+                    nested: Optional[Dict[str, type]] = None):
+    """Instantiate ``cls`` from dotted ``values``."""
+    nested = nested or {}
+    cls = typing.get_origin(cls) or cls  # unwrap PerceiverIOConfig[E, D]
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for field in dataclasses.fields(cls):
+        tp = nested.get(field.name, hints.get(field.name, str))
+        key = f"{prefix}.{field.name}"
+        if dataclasses.is_dataclass(tp):
+            sub_keys = [k for k in values if k.startswith(key + ".")]
+            if sub_keys or not _has_default(field):
+                kwargs[field.name] = build_dataclass(tp, values, key)
+        elif key in values:
+            kwargs[field.name] = _coerce(values[key], tp)
+    return cls(**kwargs)
+
+
+def _has_default(field) -> bool:
+    return (
+        field.default is not dataclasses.MISSING
+        or field.default_factory is not dataclasses.MISSING
+    )
+
+
+# -- optimizer / scheduler args -------------------------------------------
+@dataclasses.dataclass
+class OptimizerArgs:
+    """``--optimizer.*`` (reference exposes these via Lightning's optimizer
+    wiring, ``scripts/cli.py:37-48``)."""
+
+    lr: float = 1e-3
+    optimizer: str = "adamw"
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+
+
+@dataclasses.dataclass
+class LRSchedulerArgs:
+    """``--lr_scheduler.*`` (reference ``perceiver/scripts/lrs.py:7-38``)."""
+
+    name: str = "cosine"  # cosine | constant | none
+    warmup_steps: int = 0
+    min_fraction: float = 0.1
+    training_steps: Optional[int] = None  # linked to trainer.max_steps
+
+
+# -- the CLI ---------------------------------------------------------------
+@dataclasses.dataclass
+class ModelFamily:
+    """Declarative description of one trainable model family.
+
+    :param build_model: ``(model_cfg, data_module) -> flax module``
+    :param make_loss: ``(model, model_cfg) -> loss_fn`` for the train step.
+    :param init_args: ``(model_cfg, batch) -> (args, kwargs)`` used for
+        ``model.init`` on the first host batch.
+    :param link: ``(data_module, values dict) -> None`` — mutate dotted model
+        values from data properties before the model config is built
+        (``link_arguments`` parity).
+    :param initial_params: optional ``(model, model_cfg, data_module) ->
+        params`` warm-start hook (e.g. encoder from MLM checkpoint).
+    """
+
+    name: str
+    config_class: type
+    data_registry: Dict[str, Callable]
+    build_model: Callable
+    make_loss: Callable
+    init_args: Callable
+    nested: Optional[Dict[str, type]] = None
+    link: Optional[Callable] = None
+    defaults: Optional[Dict[str, Any]] = None
+    initial_params: Optional[Callable] = None
+    frozen_prefixes: Optional[Callable] = None  # (model_cfg) -> tuple of paths
+
+
+def _parse_dotted(argv: Sequence[str], known: Dict[str, Any]) -> Dict[str, Any]:
+    values: Dict[str, Any] = {}
+    i = 0
+    argv = list(argv)
+    while i < len(argv):
+        arg = argv[i]
+        if not arg.startswith("--"):
+            raise SystemExit(f"unexpected argument {arg!r}")
+        if "=" in arg:
+            key, text = arg[2:].split("=", 1)
+        else:
+            key = arg[2:]
+            if i + 1 >= len(argv):
+                raise SystemExit(f"missing value for --{key}")
+            text = argv[i + 1]
+            i += 1
+        if key not in known:
+            raise SystemExit(
+                f"unknown flag --{key}; known flags include: "
+                + ", ".join(sorted(known)[:12])
+                + ", ..."
+            )
+        values[key] = _parse_value(text, known[key]) if isinstance(text, str) else text
+        i += 1
+    return values
+
+
+class CLI:
+    """fit/validate/preproc driver for one :class:`ModelFamily`."""
+
+    def __init__(self, family: ModelFamily):
+        self.family = family
+
+    # -- flag space --------------------------------------------------------
+    def _known_flags(self, data_cls) -> Dict[str, Any]:
+        from perceiver_io_tpu.training.trainer import TrainerConfig
+
+        known: Dict[str, Any] = {"config": str, "data": str}
+        known.update(flag_specs(self.family.config_class, "model", self.family.nested))
+        known.update(_ctor_flag_specs(data_cls, "data"))
+        known.update(flag_specs(TrainerConfig, "trainer"))
+        known.update(flag_specs(OptimizerArgs, "optimizer"))
+        known.update(flag_specs(LRSchedulerArgs, "lr_scheduler"))
+        from perceiver_io_tpu.parallel import MeshConfig
+
+        known.update(flag_specs(MeshConfig, "mesh"))
+        return known
+
+    def main(self, argv: Optional[Sequence[str]] = None) -> Any:
+        argv = list(sys.argv[1:] if argv is None else argv)
+        if not argv or argv[0] in ("-h", "--help"):
+            self._print_help()
+            return None
+        subcommand = argv[0]
+        if subcommand not in ("fit", "validate", "preproc"):
+            raise SystemExit(f"unknown subcommand {subcommand!r} (fit|validate|preproc)")
+
+        # data module choice first (its ctor defines the --data.* space)
+        data_name = None
+        for arg in argv[1:]:
+            if arg.startswith("--data=") :
+                data_name = arg.split("=", 1)[1]
+            elif arg == "--data":
+                idx = argv.index(arg)
+                data_name = argv[idx + 1] if idx + 1 < len(argv) else None
+        registry = self.family.data_registry
+        if data_name is None:
+            data_name = next(iter(registry))
+        if data_name not in registry:
+            raise SystemExit(
+                f"unknown data module {data_name!r}; choose from {sorted(registry)}"
+            )
+        data_cls = registry[data_name]
+
+        known = self._known_flags(data_cls)
+        values = dict(self.family.defaults or {})
+        cli_values = _parse_dotted(argv[1:], known)
+        if "config" in cli_values:
+            import yaml
+
+            with open(cli_values.pop("config")) as fh:
+                for key, val in (yaml.safe_load(fh) or {}).items():
+                    values[key] = val
+        values.update(cli_values)
+        values.pop("data", None)
+        return self.run(subcommand, data_cls, values)
+
+    # -- execution ---------------------------------------------------------
+    def run(self, subcommand: str, data_cls, values: Dict[str, Any]) -> Any:
+        import optax
+
+        from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+        from perceiver_io_tpu.training.lrs import constant_with_warmup, cosine_with_warmup
+        from perceiver_io_tpu.training.optim import make_optimizer
+        from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+        data_kwargs = {
+            k.split(".", 1)[1]: v for k, v in values.items() if k.startswith("data.")
+        }
+        dm = data_cls(**data_kwargs)
+        dm.prepare_data()
+        if subcommand == "preproc":
+            return None
+        dm.setup()
+
+        if self.family.link is not None:
+            self.family.link(dm, values)
+        model_cfg = build_dataclass(
+            self.family.config_class, values, "model", self.family.nested
+        )
+        model = self.family.build_model(model_cfg, dm)
+
+        trainer_cfg = build_dataclass(TrainerConfig, values, "trainer")
+        opt = build_dataclass(OptimizerArgs, values, "optimizer")
+        lrs = build_dataclass(LRSchedulerArgs, values, "lr_scheduler")
+
+        steps = lrs.training_steps or trainer_cfg.max_steps
+        if lrs.name == "cosine":
+            schedule = cosine_with_warmup(
+                opt.lr, warmup_steps=lrs.warmup_steps,
+                training_steps=steps, min_fraction=lrs.min_fraction,
+            )
+        elif lrs.name == "constant":
+            schedule = constant_with_warmup(opt.lr, warmup_steps=lrs.warmup_steps)
+        else:
+            schedule = None
+        tx = make_optimizer(
+            schedule if schedule is not None else opt.lr,
+            optimizer=opt.optimizer,
+            weight_decay=opt.weight_decay,
+            b1=opt.b1,
+            b2=opt.b2,
+            frozen_prefixes=(
+                self.family.frozen_prefixes(model_cfg)
+                if self.family.frozen_prefixes is not None
+                else ()
+            ),
+        )
+
+        mesh = make_mesh(build_dataclass(MeshConfig, values, "mesh"))
+        trainer = Trainer(
+            trainer_cfg,
+            mesh,
+            self.family.make_loss(model, model_cfg),
+            tx,
+            model_config=model_cfg,
+            lr_schedule=schedule,
+        )
+
+        first_batch = next(iter(dm.train_dataloader()))
+
+        def init_params():
+            args, kwargs = self.family.init_args(model_cfg, first_batch)
+            return model.init(jax.random.PRNGKey(trainer_cfg.seed), *args, **kwargs)[
+                "params"
+            ]
+
+        initial = None
+        if self.family.initial_params is not None:
+            initial = self.family.initial_params(model, model_cfg, dm)
+
+        if subcommand == "validate":
+            trainer.setup_state(init_params, initial_params=initial)
+            metrics = trainer.validate(dm.val_dataloader())
+            trainer.close()
+            return metrics
+
+        state = trainer.fit(
+            init_params,
+            dm.train_dataloader(),
+            val_data=dm.val_dataloader,
+            initial_params=initial,
+        )
+        trainer.close()
+        return state
+
+    def _print_help(self) -> None:
+        print(f"usage: {self.family.name} {{fit|validate|preproc}} [--flag=value ...]")
+        print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
+              "--lr_scheduler.* --config=<yaml> --data=<name>")
+        print(f"data modules: {sorted(self.family.data_registry)}")
+
+
+def _ctor_flag_specs(cls, prefix: str) -> Dict[str, Any]:
+    """Flag specs from ``__init__`` signatures (datamodules are plain
+    classes, not dataclasses). Walks the MRO while ``**kwargs`` forwards to
+    the base class, so subclass flags include inherited knobs."""
+    import inspect
+
+    specs: Dict[str, Any] = {}
+    for klass in cls.__mro__:
+        if klass is object or "__init__" not in vars(klass):
+            continue
+        sig = inspect.signature(klass.__init__)
+        hints = typing.get_type_hints(klass.__init__)
+        has_var_kw = False
+        for name, param in sig.parameters.items():
+            if param.kind is inspect.Parameter.VAR_KEYWORD:
+                has_var_kw = True
+                continue
+            if name == "self" or param.kind is inspect.Parameter.VAR_POSITIONAL:
+                continue
+            specs.setdefault(f"{prefix}.{name}", hints.get(name, str))
+        if not has_var_kw:
+            break
+    return specs

@@ -1,0 +1,76 @@
+"""Text classifier CLI (reference ``perceiver/scripts/text/classifier.py``).
+
+Two-stage training parity (reference ``classifier/lightning.py:14-44``):
+``--model.encoder.params=<pretrained-dir>`` warm-starts the encoder from a
+saved MLM checkpoint; ``--model.encoder.freeze=true`` masks its parameters
+out of the optimizer (decoder-only stage).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.data.text.sources import ImdbDataModule, ListDataModule
+from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifierConfig
+from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
+from perceiver_io_tpu.models.text.common import TextEncoderConfig
+from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
+from perceiver_io_tpu.training.tasks import classifier_loss_fn
+
+DATA = {
+    "imdb": ImdbDataModule,
+    "list": ListDataModule,
+}
+
+
+def _link(dm, values):
+    values.setdefault("model.encoder.vocab_size", dm.vocab_size)
+    values.setdefault("model.encoder.max_seq_len", dm.max_seq_len)
+    if dm.num_classes is not None:
+        values.setdefault("model.decoder.num_classes", dm.num_classes)
+
+
+def _initial_params(model, cfg, dm):
+    """Warm start: replace the fresh encoder subtree with the pretrained one
+    (reference ``classifier/lightning.py:30-37``)."""
+    if cfg.encoder.params is None:
+        return None
+    from perceiver_io_tpu.training.checkpoint import load_subtree
+
+    batch_ids = jnp.zeros((1, cfg.encoder.max_seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), batch_ids)["params"]
+    params = dict(params)
+    params["encoder"] = load_subtree(
+        cfg.encoder.params, "encoder", target=params["encoder"]
+    )
+    return params
+
+
+FAMILY = ModelFamily(
+    name="perceiver_io_tpu.scripts.text.classifier",
+    config_class=TextClassifierConfig,
+    nested={"encoder": TextEncoderConfig, "decoder": ClassificationDecoderConfig},
+    data_registry=DATA,
+    build_model=lambda cfg, dm: TextClassifier(cfg),
+    make_loss=lambda model, cfg: classifier_loss_fn(model),
+    init_args=lambda cfg, batch: ((jnp.asarray(batch["input_ids"][:1]),), {}),
+    link=_link,
+    initial_params=_initial_params,
+    frozen_prefixes=lambda cfg: ("encoder",) if cfg.encoder.freeze else (),
+    defaults={
+        "data.task": "clf",
+        "model.num_latents": 256,
+        "model.num_latent_channels": 1280,
+        "model.decoder.num_output_query_channels": 1280,
+        "lr_scheduler.name": "constant",
+        "lr_scheduler.warmup_steps": 100,
+    },
+)
+
+
+def main(argv=None):
+    return CLI(FAMILY).main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -102,7 +102,7 @@ def classifier_loss_fn(model) -> Callable:
     def loss_fn(params, batch, rng):
         logits = model.apply(
             {"params": params},
-            batch["x"],
+            batch["input_ids"],
             pad_mask=batch.get("pad_mask"),
             deterministic=rng is None,
             rngs=_rngs(rng),

@@ -139,12 +139,7 @@ class Trainer:
             instead of ``init_params_fn``'s fresh init values.
         """
         cfg = self.config
-        self.state, self._shardings = create_train_state(
-            init_params_fn,
-            self.tx,
-            self.mesh,
-            initial_params=initial_params,
-        )
+        self.setup_state(init_params_fn, initial_params=initial_params)
         train_step = make_train_step(
             self.loss_fn,
             self.mesh,
@@ -205,6 +200,22 @@ class Trainer:
                         if self.is_main_process:
                             cb(self, self.state, step_idx, val_metrics)
                     t0 = time.time()
+        return self.state
+
+    def setup_state(
+        self,
+        init_params_fn: Callable[[], Any],
+        *,
+        initial_params: Any = None,
+    ) -> TrainState:
+        """Create (or warm-start) the sharded train state without fitting —
+        the ``validate``-only entry (reference CLI subcommand parity)."""
+        self.state, self._shardings = create_train_state(
+            init_params_fn,
+            self.tx,
+            self.mesh,
+            initial_params=initial_params,
+        )
         return self.state
 
     def validate(self, val_data: Iterable) -> dict:

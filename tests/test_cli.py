@@ -1,0 +1,139 @@
+"""CLI layer: dataclass-flag engine units + a full `fit`/`validate` drive of
+the CLM family over synthetic text (reference CLI surface,
+``perceiver/scripts/cli.py``)."""
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.text.sources import ListDataModule
+from perceiver_io_tpu.scripts import cli as cli_mod
+from perceiver_io_tpu.scripts.cli import (
+    CLI,
+    LRSchedulerArgs,
+    OptimizerArgs,
+    _parse_value,
+    build_dataclass,
+    flag_specs,
+)
+from perceiver_io_tpu.scripts.text import clm as clm_script
+
+
+# -- flag engine ----------------------------------------------------------
+def test_parse_value_types():
+    assert _parse_value("3", int) == 3
+    assert _parse_value("3.5", float) == 3.5
+    assert _parse_value("true", bool) is True
+    assert _parse_value("false", bool) is False
+    assert _parse_value("none", Optional[int]) is None
+    assert _parse_value("7", Optional[int]) == 7
+    assert _parse_value("1,2,3", Tuple[int, ...]) == (1, 2, 3)
+    with pytest.raises(ValueError):
+        _parse_value("maybe", bool)
+
+
+def test_flag_specs_nested():
+    from perceiver_io_tpu.models.text.common import TextEncoderConfig
+    from perceiver_io_tpu.models.text.mlm import MaskedLanguageModelConfig, TextDecoderConfig
+
+    specs = flag_specs(
+        MaskedLanguageModelConfig,
+        "model",
+        {"encoder": TextEncoderConfig, "decoder": TextDecoderConfig},
+    )
+    assert "model.encoder.vocab_size" in specs
+    assert "model.decoder.num_output_query_channels" in specs
+    assert "model.num_latents" in specs
+
+
+def test_build_dataclass_from_dotted():
+    opt = build_dataclass(OptimizerArgs, {"optimizer.lr": "1e-4", "optimizer.b1": 0.8}, "optimizer")
+    assert opt.lr == 1e-4 and opt.b1 == 0.8 and opt.optimizer == "adamw"
+    lrs = build_dataclass(LRSchedulerArgs, {}, "lr_scheduler")
+    assert lrs.name == "cosine"
+
+
+def test_unknown_flag_rejected():
+    family = _toy_family()
+    with pytest.raises(SystemExit, match="unknown flag"):
+        CLI(family).main(["fit", "--model.not_a_field=3"])
+
+
+# -- end-to-end fit/validate ----------------------------------------------
+class ToyTextDataModule(ListDataModule):
+    """Flag-constructible synthetic corpus."""
+
+    def __init__(self, dataset_dir: str = ".cache/toy", **kwargs):
+        rng = np.random.default_rng(0)
+        words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+        texts = [
+            " ".join(rng.choice(words, size=30)) for _ in range(24)
+        ]
+        super().__init__(
+            train_texts=texts,
+            valid_texts=texts[:8],
+            dataset_dir=dataset_dir,
+            **kwargs,
+        )
+
+
+def _toy_family():
+    return dataclasses.replace(clm_script.FAMILY, data_registry={"toy": ToyTextDataModule})
+
+
+@pytest.mark.slow
+def test_clm_cli_fit_and_validate(tmp_path):
+    family = _toy_family()
+    argv = [
+        "--data=toy",
+        f"--data.dataset_dir={tmp_path}/data",
+        "--data.max_seq_len=64",
+        "--data.batch_size=8",
+        "--model.max_latents=32",
+        "--model.num_channels=32",
+        "--model.num_heads=2",
+        "--model.num_self_attention_layers=2",
+        "--model.cross_attention_dropout=0.0",
+        "--optimizer.lr=1e-3",
+        "--trainer.max_steps=3",
+        "--trainer.val_check_interval=3",
+        "--trainer.log_every_n_steps=2",
+        f"--trainer.default_root_dir={tmp_path}/logs",
+        "--trainer.enable_checkpointing=false",
+        "--trainer.enable_tensorboard=false",
+    ]
+    state = CLI(family).main(["fit", *argv])
+    assert state is not None and int(state.step) == 3
+
+    metrics = CLI(family).main(["validate", *argv])
+    assert "loss" in metrics and np.isfinite(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_cli_yaml_config_defaults(tmp_path):
+    import yaml
+
+    family = _toy_family()
+    config = {
+        "data.dataset_dir": f"{tmp_path}/data",
+        "data.max_seq_len": 64,
+        "data.batch_size": 8,
+        "model.max_latents": 32,
+        "model.num_channels": 32,
+        "model.num_heads": 2,
+        "model.num_self_attention_layers": 1,
+        "model.cross_attention_dropout": 0.0,
+        "trainer.max_steps": 1,
+        "trainer.val_check_interval": 10,
+        "trainer.default_root_dir": f"{tmp_path}/logs",
+        "trainer.enable_checkpointing": False,
+        "trainer.enable_tensorboard": False,
+    }
+    cfg_file = tmp_path / "cfg.yaml"
+    cfg_file.write_text(yaml.safe_dump(config))
+    # CLI flag overrides the YAML value
+    state = CLI(family).main(
+        ["fit", "--data=toy", f"--config={cfg_file}", "--trainer.max_steps=2"]
+    )
+    assert int(state.step) == 2
