@@ -43,6 +43,7 @@ class ByteTokenizer:
     mask_token_id = MASK_ID
     cls_token_id = CLS_ID
     sep_token_id = SEP_ID
+    mask_token = "<mask>"  # placeholder substring, mapped to MASK_ID in encode
     name = "byte"
 
     _WHITESPACE_IDS = frozenset(b + BYTE_OFFSET for b in string.whitespace.encode())
@@ -52,7 +53,11 @@ class ByteTokenizer:
 
     # -- encode / decode ----------------------------------------------------
     def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
-        ids = [b + BYTE_OFFSET for b in text.encode("utf-8")]
+        ids: List[int] = []
+        for i, part in enumerate(text.split(self.mask_token)):
+            if i > 0:
+                ids.append(MASK_ID)
+            ids.extend(b + BYTE_OFFSET for b in part.encode("utf-8"))
         if add_special_tokens:
             ids = [CLS_ID] + ids + [SEP_ID]
         return ids
@@ -66,6 +71,9 @@ class ByteTokenizer:
             elif not skip_special_tokens:
                 out += f"[{i}]".encode()
         return out.decode("utf-8", errors="replace")
+
+    def batch_decode(self, rows, skip_special_tokens: bool = True) -> List[str]:
+        return [self.decode(r, skip_special_tokens) for r in rows]
 
     def encode_batch(
         self,
@@ -154,8 +162,17 @@ class HFTokenizer:
         return self.hf.mask_token_id
 
     @property
+    def mask_token(self):
+        return self.hf.mask_token
+
+    @property
     def eos_token_id(self):
         return self.hf.eos_token_id
+
+    def batch_decode(self, rows, skip_special_tokens: bool = True) -> List[str]:
+        return self.hf.batch_decode(
+            [[int(i) for i in r] for r in rows], skip_special_tokens=skip_special_tokens
+        )
 
     def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
         return self.hf(text, add_special_tokens=add_special_tokens)["input_ids"]

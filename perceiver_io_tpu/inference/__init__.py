@@ -9,5 +9,28 @@ caching interacts with the prefix/latent boundary).
 from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
 from perceiver_io_tpu.inference.generate import generate
 from perceiver_io_tpu.inference.mask_filler import MaskFiller
+from perceiver_io_tpu.inference.pipelines import (
+    FillMaskPipeline,
+    ImageClassificationPipeline,
+    OpticalFlowPipeline,
+    SymbolicAudioPipeline,
+    TextClassificationPipeline,
+    TextGenerationPipeline,
+    pipeline,
+    pipeline_from_pretrained,
+)
 
-__all__ = ["SamplingConfig", "sample_logits", "generate", "MaskFiller"]
+__all__ = [
+    "SamplingConfig",
+    "sample_logits",
+    "generate",
+    "MaskFiller",
+    "pipeline",
+    "pipeline_from_pretrained",
+    "TextGenerationPipeline",
+    "FillMaskPipeline",
+    "TextClassificationPipeline",
+    "ImageClassificationPipeline",
+    "OpticalFlowPipeline",
+    "SymbolicAudioPipeline",
+]

@@ -1,0 +1,304 @@
+"""Task pipelines — the torch-free equivalent of the reference's Hugging
+Face pipeline registrations (SURVEY.md §2.2): ``text-generation``,
+``fill-mask``, ``sentiment-analysis``, ``image-classification``, the custom
+``optical-flow`` pipeline (``optical_flow/huggingface.py:71-124``) and the
+custom ``symbolic-audio-generation`` pipeline
+(``symbolic/huggingface.py:161-298``).
+
+Each pipeline wraps (model, params, preprocessing) behind one callable; model
+forwards are jitted once per pipeline and batches are padded to static
+shapes, so repeated calls never recompile. :func:`pipeline` dispatches on
+task name like ``transformers.pipeline``; :func:`pipeline_from_pretrained`
+builds one straight from a ``save_pretrained`` dir via the embedded config.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+from perceiver_io_tpu.inference.mask_filler import MaskFiller
+from perceiver_io_tpu.inference.samplers import SamplingConfig
+
+
+class _Pipeline:
+    """Shared (model, params) plumbing; jitted apply cached per pipeline."""
+
+    def __init__(self, model, params):
+        self.model = model
+        self.params = params
+        self._apply = jax.jit(self._forward)
+
+    def _forward(self, params, *args, **kwargs):
+        return self.model.apply({"params": params}, *args, **kwargs)
+
+
+def _pad_batch(rows: List[np.ndarray], pad_id: int, side: str) -> Tuple[np.ndarray, np.ndarray]:
+    width = max(len(r) for r in rows)
+    out = np.full((len(rows), width), pad_id, np.int32)
+    for i, row in enumerate(rows):
+        if side == "left":
+            out[i, width - len(row):] = row
+        else:
+            out[i, : len(row)] = row
+    return out, out == pad_id
+
+
+class TextGenerationPipeline(_Pipeline):
+    """``pipeline("text-generation")`` parity (reference
+    ``clm/huggingface.py:100-143``): prompts → continuation text via the
+    on-device ``lax.scan`` decode loop."""
+
+    def __init__(self, model, params, tokenizer):
+        super().__init__(model, params)
+        self.tokenizer = tokenizer
+
+    def __call__(
+        self,
+        prompts: Union[str, Sequence[str]],
+        *,
+        max_new_tokens: int = 64,
+        num_latents: int = 1,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+        return_full_text: bool = True,
+    ) -> List[str]:
+        single = isinstance(prompts, str)
+        batch = [prompts] if single else list(prompts)
+        encoded = [np.asarray(self.tokenizer.encode(p), np.int32) for p in batch]
+        pad_id = self.tokenizer.pad_token_id or 0
+        ids, pad = _pad_batch(encoded, pad_id, "left")
+        pad_count = pad.sum(axis=1).astype(np.int32)
+
+        config = GenerationConfig(
+            max_new_tokens=max_new_tokens,
+            num_latents=num_latents,
+            pad_token_id=pad_id,
+            eos_token_id=self.tokenizer.eos_token_id,
+            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p),
+        )
+        out = generate(
+            self.model,
+            self.params,
+            jnp.asarray(ids),
+            config,
+            rng=jax.random.PRNGKey(seed),
+            prompt_pad_count=jnp.asarray(pad_count),
+        )
+        texts = []
+        for prompt, row in zip(batch, np.asarray(out)):
+            new = self.tokenizer.decode([t for t in row.tolist() if t != pad_id])
+            texts.append(prompt + new if return_full_text else new)
+        return texts[0:1] if single else texts
+
+
+class FillMaskPipeline(_Pipeline):
+    """``pipeline("fill-mask")`` parity: top-k fillings per masked text."""
+
+    def __init__(self, model, params, preprocessor):
+        super().__init__(model, params)
+        self._filler = MaskFiller(preprocessor)
+
+    def __call__(
+        self, texts: Union[str, Sequence[str]], *, top_k: int = 5
+    ) -> List[List[str]]:
+        batch = [texts] if isinstance(texts, str) else list(texts)
+        _, filled = self._filler.fill(self.model, self.params, batch, top_k)
+        return filled
+
+
+class TextClassificationPipeline(_Pipeline):
+    """``pipeline("sentiment-analysis")`` parity (reference
+    ``classifier/huggingface.py``)."""
+
+    def __init__(self, model, params, preprocessor, labels: Sequence[str] = ("NEGATIVE", "POSITIVE")):
+        super().__init__(model, params)
+        self.preprocessor = preprocessor
+        self.labels = list(labels)
+
+    def __call__(self, texts: Union[str, Sequence[str]]) -> List[Dict[str, Any]]:
+        batch = [texts] if isinstance(texts, str) else list(texts)
+        ids, pad = self.preprocessor.preprocess_batch(batch)
+        logits = self._apply(self.params, jnp.asarray(ids), pad_mask=jnp.asarray(pad))
+        probs = np.asarray(jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
+        out = []
+        for row in probs:
+            idx = int(row.argmax())
+            out.append({"label": self.labels[idx], "score": float(row[idx])})
+        return out
+
+
+class ImageClassificationPipeline(_Pipeline):
+    """``pipeline("image-classification")`` parity (reference
+    ``image_classifier/huggingface.py:37-235``): channels-last uint8 images →
+    top-k labels."""
+
+    def __init__(self, model, params, preprocessor=None, labels: Optional[Sequence[str]] = None):
+        from perceiver_io_tpu.data.vision import ImagePreprocessor
+
+        super().__init__(model, params)
+        self.preprocessor = preprocessor or ImagePreprocessor()
+        self.labels = labels
+
+    def __call__(
+        self, images: np.ndarray, *, top_k: int = 1
+    ) -> List[List[Dict[str, Any]]]:
+        x = self.preprocessor(np.asarray(images))
+        logits = self._apply(self.params, jnp.asarray(x))
+        probs = np.asarray(jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
+        results = []
+        for row in probs:
+            order = np.argsort(-row)[:top_k]
+            results.append(
+                [
+                    {
+                        "label": self.labels[i] if self.labels else int(i),
+                        "score": float(row[i]),
+                    }
+                    for i in order
+                ]
+            )
+        return results
+
+
+class OpticalFlowPipeline(_Pipeline):
+    """The reference's custom ``optical-flow`` pipeline
+    (``optical_flow/huggingface.py:71-124``): frame pairs → per-pixel flow,
+    micro-batched over patches with static compiled shapes, optionally
+    rendered to RGB."""
+
+    def __init__(self, model, params, *, patch_size: Tuple[int, int] = (368, 496),
+                 patch_min_overlap: int = 20, batch_size: int = 1, render: bool = False):
+        from perceiver_io_tpu.data.vision import OpticalFlowProcessor
+
+        super().__init__(model, params)
+        self.processor = OpticalFlowProcessor(
+            patch_size=patch_size, patch_min_overlap=patch_min_overlap
+        )
+        self.batch_size = batch_size
+        self.render = render
+
+    def __call__(
+        self,
+        image_pairs: Union[Tuple[np.ndarray, np.ndarray], Sequence[Tuple[np.ndarray, np.ndarray]]],
+    ):
+        single = (
+            len(image_pairs) == 2
+            and isinstance(image_pairs[0], np.ndarray)
+            and image_pairs[0].ndim >= 2
+        )
+        pairs = [image_pairs] if single else list(image_pairs)
+
+        def model_fn(x):
+            return np.asarray(self._apply(self.params, jnp.asarray(x)))
+
+        flow = self.processor.process(model_fn, pairs, batch_size=self.batch_size)
+        if self.render:
+            from perceiver_io_tpu.data.vision import render_optical_flow
+
+            rendered = np.stack([render_optical_flow(f) for f in flow])
+            return rendered[0] if single else rendered
+        return flow[0] if single else flow
+
+
+class SymbolicAudioPipeline(_Pipeline):
+    """The reference's custom ``symbolic-audio-generation`` pipeline
+    (``symbolic/huggingface.py:161-298``): MIDI (or event ids) in → token
+    generation → MIDI out; optional WAV rendering via a fluidsynth
+    subprocess when both pretty_midi and fluidsynth are present."""
+
+    def __init__(self, model, params):
+        super().__init__(model, params)
+
+    def __call__(
+        self,
+        prompts: Union[Sequence[int], Sequence[Sequence[int]], "np.ndarray"],
+        *,
+        max_new_tokens: int = 256,
+        num_latents: int = 1,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+    ) -> List[np.ndarray]:
+        from perceiver_io_tpu.data.audio import PAD_TOKEN
+
+        if isinstance(prompts, np.ndarray) and prompts.ndim == 1:
+            batch = [np.asarray(prompts, np.int32)]
+        elif isinstance(prompts, (list, tuple)) and prompts and np.isscalar(prompts[0]):
+            batch = [np.asarray(prompts, np.int32)]  # single flat prompt
+        else:
+            batch = [np.asarray(r, np.int32) for r in prompts]  # ragged batch
+        ids, pad = _pad_batch(batch, PAD_TOKEN, "left")
+        pad_count = pad.sum(axis=1).astype(np.int32)
+
+        config = GenerationConfig(
+            max_new_tokens=max_new_tokens,
+            num_latents=num_latents,
+            pad_token_id=PAD_TOKEN,
+            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p),
+        )
+        out = generate(
+            self.model,
+            self.params,
+            jnp.asarray(ids),
+            config,
+            rng=jax.random.PRNGKey(seed),
+            prompt_pad_count=jnp.asarray(pad_count),
+        )
+        return [np.concatenate([p, row]) for p, row in zip(batch, np.asarray(out))]
+
+    def generate_midi(self, prompt_events: Sequence[int], path=None, **kwargs):
+        """Generate and decode to a MIDI object/file (requires pretty_midi)."""
+        from perceiver_io_tpu.data.audio import decode_to_midi_file
+
+        events = self([np.asarray(prompt_events, np.int32)], **kwargs)[0]
+        return decode_to_midi_file(events, path)
+
+    @staticmethod
+    def render_wav(midi_path: str, wav_path: str, sound_font: str) -> None:
+        """WAV render through the fluidsynth CLI (the reference shells out
+        the same way, ``symbolic/huggingface.py:270-279``)."""
+        import subprocess
+
+        subprocess.run(
+            ["fluidsynth", "-ni", sound_font, midi_path, "-F", wav_path],
+            check=True,
+        )
+
+
+_TASKS = {
+    "text-generation": TextGenerationPipeline,
+    "fill-mask": FillMaskPipeline,
+    "sentiment-analysis": TextClassificationPipeline,
+    "text-classification": TextClassificationPipeline,
+    "image-classification": ImageClassificationPipeline,
+    "optical-flow": OpticalFlowPipeline,
+    "symbolic-audio-generation": SymbolicAudioPipeline,
+}
+
+
+def pipeline(task: str, model, params, *args, **kwargs):
+    """``transformers.pipeline``-shaped dispatch by task name."""
+    if task not in _TASKS:
+        raise ValueError(f"unknown task {task!r}; available: {sorted(_TASKS)}")
+    return _TASKS[task](model, params, *args, **kwargs)
+
+
+def pipeline_from_pretrained(task: str, path: str, *args, dtype=None,
+                             attention_impl: str = "auto", **kwargs):
+    """Build a pipeline straight from a ``save_pretrained`` dir: the embedded
+    config picks the model class (reference ``from_pretrained`` parity)."""
+    from perceiver_io_tpu.models import model_for_config
+    from perceiver_io_tpu.training.checkpoint import load_pretrained
+
+    params, config = load_pretrained(path)
+    if config is None:
+        raise ValueError(f"{path} has no embedded model config")
+    model = model_for_config(config, dtype=dtype, attention_impl=attention_impl)
+    return pipeline(task, model, params, *args, **kwargs)

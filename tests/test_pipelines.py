@@ -1,0 +1,203 @@
+"""Inference pipelines over tiny models — the reference's six HF pipeline
+surfaces (SURVEY.md §2.2) driven end to end."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.text.preprocessor import TextPreprocessor
+from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+from perceiver_io_tpu.inference import pipeline
+from perceiver_io_tpu.models.core.config import (
+    ClassificationDecoderConfig,
+    PerceiverIOConfig,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture(scope="module")
+def tiny_clm():
+    from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=262, max_seq_len=32, max_latents=16, num_channels=32,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 16)["params"]
+    return model, params
+
+
+def test_text_generation_pipeline(tiny_clm):
+    model, params = tiny_clm
+    pipe = pipeline("text-generation", model, params, ByteTokenizer(padding_side="left"))
+    outs = pipe(["hello", "hi"], max_new_tokens=4, num_latents=4, temperature=0.0)
+    assert len(outs) == 2
+    assert outs[0].startswith("hello")
+    new_only = pipe("hello", max_new_tokens=4, num_latents=4, temperature=0.0,
+                    return_full_text=False)
+    assert len(new_only) == 1 and not new_only[0].startswith("hello")
+
+
+def test_fill_mask_pipeline():
+    from perceiver_io_tpu.models.text.common import TextEncoderConfig
+    from perceiver_io_tpu.models.text.mlm import (
+        MaskedLanguageModel,
+        TextDecoderConfig,
+    )
+
+    tokenizer = ByteTokenizer()
+    cfg = PerceiverIOConfig(
+        encoder=TextEncoderConfig(
+            vocab_size=tokenizer.vocab_size, max_seq_len=32, num_input_channels=16,
+            num_cross_attention_heads=1, num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=TextDecoderConfig(vocab_size=tokenizer.vocab_size, max_seq_len=32),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    model = MaskedLanguageModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    prep = TextPreprocessor(tokenizer, max_seq_len=32)
+    pipe = pipeline("fill-mask", model, params, prep)
+    filled = pipe("a<mask>c", top_k=3)
+    assert len(filled) == 1 and len(filled[0]) == 3
+    # every filling restores the unmasked characters
+    assert all(f.startswith("a") and f.endswith("c") and len(f) == 3 for f in filled[0])
+
+
+def test_text_classification_pipeline():
+    from perceiver_io_tpu.models.text.classifier import TextClassifier
+    from perceiver_io_tpu.models.text.common import TextEncoderConfig
+
+    tokenizer = ByteTokenizer()
+    cfg = PerceiverIOConfig(
+        encoder=TextEncoderConfig(
+            vocab_size=tokenizer.vocab_size, max_seq_len=32, num_input_channels=16,
+            num_cross_attention_heads=1, num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=2, num_output_query_channels=16, num_cross_attention_heads=1
+        ),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    model = TextClassifier(cfg)
+    params = model.init(KEY, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    pipe = pipeline(
+        "sentiment-analysis", model, params, TextPreprocessor(tokenizer, max_seq_len=32)
+    )
+    out = pipe(["great movie", "terrible movie"])
+    assert len(out) == 2
+    assert all(o["label"] in ("NEGATIVE", "POSITIVE") and 0 <= o["score"] <= 1 for o in out)
+
+
+def test_image_classification_pipeline():
+    from perceiver_io_tpu.models.vision.image_classifier import (
+        ImageClassifier,
+        ImageEncoderConfig,
+    )
+
+    cfg = PerceiverIOConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(8, 8, 1), num_frequency_bands=4,
+            num_cross_attention_heads=1, num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=10, num_output_query_channels=16, num_cross_attention_heads=2
+        ),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    model = ImageClassifier(cfg)
+    params = model.init(KEY, jnp.zeros((1, 8, 8, 1)))["params"]
+
+    pipe = pipeline("image-classification", model, params)
+    imgs = np.random.default_rng(0).integers(0, 256, (3, 8, 8), dtype=np.uint8)
+    out = pipe(imgs, top_k=2)
+    assert len(out) == 3 and len(out[0]) == 2
+    assert out[0][0]["score"] >= out[0][1]["score"]
+
+
+def test_optical_flow_pipeline():
+    from perceiver_io_tpu.models.vision.optical_flow import (
+        OpticalFlow,
+        OpticalFlowDecoderConfig,
+        OpticalFlowEncoderConfig,
+    )
+
+    cfg = PerceiverIOConfig(
+        encoder=OpticalFlowEncoderConfig(
+            image_shape=(8, 8), num_frequency_bands=4,
+            num_cross_attention_heads=1, num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=OpticalFlowDecoderConfig(image_shape=(8, 8), num_cross_attention_heads=1),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    model = OpticalFlow(cfg)
+    params = model.init(KEY, jnp.zeros((1, 2, 27, 8, 8)))["params"]
+
+    pipe = pipeline("optical-flow", model, params, patch_size=(8, 8), patch_min_overlap=2, batch_size=2)
+    rng = np.random.default_rng(0)
+    pair = (
+        rng.integers(0, 256, (10, 12, 3), dtype=np.uint8),
+        rng.integers(0, 256, (10, 12, 3), dtype=np.uint8),
+    )
+    flow = pipe(pair)
+    assert flow.shape == (10, 12, 2)
+    rendered = pipeline(
+        "optical-flow", model, params, patch_size=(8, 8), patch_min_overlap=2, batch_size=2, render=True
+    )(pair)
+    assert rendered.shape == (10, 12, 3) and rendered.dtype == np.uint8
+
+
+def test_symbolic_audio_pipeline():
+    from perceiver_io_tpu.models.audio.symbolic import (
+        SymbolicAudioModel,
+        SymbolicAudioModelConfig,
+    )
+
+    cfg = SymbolicAudioModelConfig(
+        max_seq_len=32, max_latents=16, num_channels=32,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = SymbolicAudioModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 16)["params"]
+
+    pipe = pipeline("symbolic-audio-generation", model, params)
+    prompt = np.array([60, 256 + 49, 128 + 60], np.int32)  # on, shift, off
+    outs = pipe([prompt, prompt[:2]], max_new_tokens=5, num_latents=4, temperature=0.0)
+    assert len(outs) == 2
+    assert len(outs[0]) == len(prompt) + 5
+    np.testing.assert_array_equal(outs[0][:3], prompt)
+    assert (np.asarray(outs[0]) < cfg.vocab_size).all()
+
+
+def test_unknown_task_rejected(tiny_clm):
+    model, params = tiny_clm
+    with pytest.raises(ValueError, match="unknown task"):
+        pipeline("not-a-task", model, params)
+
+
+def test_pipeline_from_pretrained_round_trip(tiny_clm, tmp_path):
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    model, params = tiny_clm
+    save_pretrained(str(tmp_path / "m"), params, model.config)
+
+    pipe = pipeline_from_pretrained(
+        "text-generation", str(tmp_path / "m"), ByteTokenizer(padding_side="left")
+    )
+    direct = pipeline("text-generation", model, params, ByteTokenizer(padding_side="left"))
+    a = pipe("hello", max_new_tokens=4, num_latents=4, temperature=0.0)
+    b = direct("hello", max_new_tokens=4, num_latents=4, temperature=0.0)
+    assert a == b
