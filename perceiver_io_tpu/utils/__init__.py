@@ -1,0 +1,22 @@
+"""Cross-cutting utilities: analytic FLOPs/params estimation for the scaling
+study (reference ``examples/scaling/clm/scaling/flops.py``) and first-class
+``jax.profiler`` tracing (the reference has no profiling story, SURVEY.md §5.1).
+"""
+from perceiver_io_tpu.utils.flops import (
+    ComputeEstimator,
+    count_params,
+    num_training_steps,
+    num_training_tokens,
+    training_flops,
+)
+from perceiver_io_tpu.utils.profiling import StepTimer, trace
+
+__all__ = [
+    "ComputeEstimator",
+    "count_params",
+    "num_training_tokens",
+    "num_training_steps",
+    "training_flops",
+    "StepTimer",
+    "trace",
+]
