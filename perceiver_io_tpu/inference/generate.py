@@ -102,6 +102,141 @@ def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     return logits
 
 
+def _decode_prefill(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.ndarray):
+    """Forward over the right-aligned window that additionally builds the
+    decode caches for the latent-growth phase.
+
+    Cache layout is **left-aligned by token index** ``p = slot - pad_count``
+    (stable as the window slides over left pads), so appends are in-place
+    writes, not rolls:
+
+    - ``cross_k/v``: ``(b, h, N, d)`` — cross-attention keys/values of every
+      real token, in its boundary-side normalization (latent keys q_norm'd,
+      prefix keys kv_norm'd — reference ``modules.py:188-203``), rotary
+      applied at angle ``p`` (relative, so shared offsets cancel).
+    - ``stack_k/v``: per layer ``(b, h, max_latents, d)`` over the ``m`` real
+      latents (left-aligned by latent age); rotary on layer 0 only,
+      mirroring the stack's first-layer-rotary semantics.
+
+    :return: (next-token logits, cache dict, length ``(b,)``, m).
+    """
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents
+
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    abs_pos = positions(b, n, shift=pad_count[:, None])
+    emb, frq = ar.input_adapter(window, abs_pos=abs_pos)
+
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    is_latent = (jnp.arange(n) >= n - num_latents)[None, :] & (
+        jnp.arange(n)[None, :] >= n - m
+    )
+    x_q_all = ca.q_norm(emb)
+    x_kv = jnp.where(is_latent[..., None], x_q_all, ca.kv_norm(emb))
+
+    x_q = x_q_all[:, -num_latents:]
+    rot = RotaryEmbedding(frq, right_align=True)
+    q = mha.project_q(x_q, rot)
+    k, v = mha.project_kv(x_kv, rot)
+    attn = mha.attend(q, k, v, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb[:, -num_latents:]
+    x = layer.mlp(x) + x
+
+    # Left-align the window-slot cross k/v by token index p = slot - pad_count.
+    slot_idx = jnp.clip(jnp.arange(n)[None, :] + pad_count[:, None], 0, n - 1)
+    cross_k = jnp.take_along_axis(k, slot_idx[:, None, :, None], axis=2)
+    cross_v = jnp.take_along_axis(v, slot_idx[:, None, :, None], axis=2)
+    length = (n - pad_count).astype(jnp.int32)
+
+    # Self-attention stack, capturing per-layer k/v of the m real latents
+    # (segment slot num_latents - m + t for latent age t).
+    stack_pad = jnp.broadcast_to(
+        jnp.arange(num_latents)[None, :] < num_latents - m, (b, num_latents)
+    )
+    frq_latent = frq[:, -num_latents:]
+    rot_latent = RotaryEmbedding(frq_latent, right_align=True)
+    seg_idx = jnp.clip(num_latents - m + jnp.arange(num_latents), 0, num_latents - 1)
+    stack_k, stack_v = [], []
+    for i, sa_layer in enumerate(ar.self_attention.layers):
+        sa = sa_layer.self_attn
+        r = rot_latent if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        stack_k.append(jnp.take_along_axis(k_s, seg_idx[None, None, :, None], axis=2))
+        stack_v.append(jnp.take_along_axis(v_s, seg_idx[None, None, :, None], axis=2))
+        attn = sa.attention.attend(q_s, k_s, v_s, pad_mask=stack_pad, deterministic=True)
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    cache = {"cross_k": cross_k, "cross_v": cross_v,
+             "stack_k": stack_k, "stack_v": stack_v}
+    return logits, cache, length, m
+
+
+def _decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray, m: jnp.ndarray):
+    """One cached decode step: run ONLY the new token through the model,
+    attending over the caches — valid while the new token is a fresh latent
+    (latent-growth phase: no boundary migration, no position shifts).
+
+    :param token: ``(b,)`` the token just appended.
+    :return: (next-token logits, cache, length + 1, m + 1).
+    """
+    ar = mdl.perceiver_ar
+    b = token.shape[0]
+    n = cache["cross_k"].shape[2]
+    num_latents = mdl.max_latents
+
+    p_new = length[:, None]  # (b, 1) token index of the new position
+    emb, frq = ar.input_adapter(token[:, None], abs_pos=p_new)
+    rot = RotaryEmbedding(frq)
+
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    x_q = ca.q_norm(emb)  # the new token is a latent: q_norm on both sides
+    q = mha.project_q(x_q, rot)
+    k_new, v_new = mha.project_kv(x_q, rot)
+    rows = jnp.arange(b)
+    cross_k = cache["cross_k"].at[rows, :, length].set(k_new[:, :, 0])
+    cross_v = cache["cross_v"].at[rows, :, length].set(v_new[:, :, 0])
+    future = jnp.arange(n)[None, :] > length[:, None]  # True = not yet written
+    attn = mha.attend(q, cross_k, cross_v, pad_mask=future, deterministic=True)
+    x = attn + emb
+    x = layer.mlp(x) + x
+
+    stack_k, stack_v = [], []
+    stack_future = jnp.broadcast_to(jnp.arange(num_latents)[None, :] > m, (b, num_latents))
+    for i, sa_layer in enumerate(ar.self_attention.layers):
+        sa = sa_layer.self_attn
+        r = rot if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        k_i = jax.lax.dynamic_update_slice(cache["stack_k"][i], k_s, (0, 0, m, 0))
+        v_i = jax.lax.dynamic_update_slice(cache["stack_v"][i], v_s, (0, 0, m, 0))
+        stack_k.append(k_i)
+        stack_v.append(v_i)
+        attn = sa.attention.attend(q_s, k_i, v_i, pad_mask=stack_future, deterministic=True)
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+
+    x_last = x[:, 0]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    cache = {"cross_k": cross_k, "cross_v": cross_v,
+             "stack_k": stack_k, "stack_v": stack_v}
+    return logits, cache, length + 1, m + 1
+
+
 def generate(
     model,
     params,
@@ -110,6 +245,7 @@ def generate(
     *,
     rng: Optional[jax.Array] = None,
     prompt_pad_count: Optional[jnp.ndarray] = None,
+    use_cache: bool = True,
 ) -> jnp.ndarray:
     """Generate ``config.max_new_tokens`` tokens after ``input_ids``.
 
@@ -143,32 +279,71 @@ def generate(
     window = jnp.full((b, n), config.pad_token_id, input_ids.dtype)
     window = window.at[:, n - prompt_len :].set(input_ids)
     pad_count = prompt_pad_count.astype(jnp.int32) + (n - prompt_len)
+    step_rngs = jax.random.split(rng, config.max_new_tokens)
 
-    def step(carry, step_rng):
-        window, pad_count, m, finished = carry
-        logits = model.apply(
-            {"params": params},
-            window,
-            pad_count,
-            m,
-            method=_decode_forward,
-        )
-        token = sample_logits(step_rng, logits, config.sampling)
+    def advance(window, pad_count, finished, token, m):
         if config.eos_token_id is not None:
             token = jnp.where(finished, config.pad_token_id, token)
             finished = finished | (token == config.eos_token_id)
-        window = jnp.concatenate([window[:, 1:], token[:, None].astype(window.dtype)], axis=1)
+        window = jnp.concatenate(
+            [window[:, 1:], token[:, None].astype(window.dtype)], axis=1
+        )
         pad_count = jnp.maximum(pad_count - 1, 0)
         m = jnp.minimum(m + 1, max_latents)
-        return (window, pad_count, m, finished), token
+        return window, pad_count, finished, token, m
 
-    carry = (
-        window,
-        pad_count,
-        jnp.asarray(num_latents, jnp.int32),
-        jnp.zeros((b,), bool),
+    # Cached fast path: valid while every generated token is a *fresh* latent
+    # and the window still slides over left pads — the latent-growth phase.
+    # Afterwards the latent/prefix boundary migrates per step (reference
+    # window schedule, ``clm/huggingface.py:53-74``), which invalidates
+    # per-position caches, so the tail falls back to windowed recompute.
+    cached_steps = (
+        min(config.max_new_tokens, max_latents - num_latents, n - prompt_len)
+        if use_cache
+        else 0
     )
-    _, tokens = jax.lax.scan(
-        step, carry, jax.random.split(rng, config.max_new_tokens)
-    )
-    return tokens.T.astype(input_ids.dtype)
+    token_blocks = []
+    m0 = jnp.asarray(num_latents, jnp.int32)
+    finished = jnp.zeros((b,), bool)
+
+    if cached_steps > 0:
+        logits, cache, length, m = model.apply(
+            {"params": params}, window, pad_count, m0, method=_decode_prefill
+        )
+
+        def cached_step(carry, step_rng):
+            window, pad_count, finished, logits, cache, length, m = carry
+            token = sample_logits(step_rng, logits, config.sampling)
+            window, pad_count, finished, token, _ = advance(
+                window, pad_count, finished, token, m
+            )
+            logits, cache, length, m = model.apply(
+                {"params": params}, token, cache, length, m, method=_decode_step
+            )
+            return (window, pad_count, finished, logits, cache, length, m), token
+
+        carry = (window, pad_count, finished, logits, cache, length, m0)
+        carry, tokens = jax.lax.scan(cached_step, carry, step_rngs[:cached_steps])
+        window, pad_count, finished = carry[0], carry[1], carry[2]
+        m0 = carry[6]
+        token_blocks.append(tokens)
+
+    remaining = config.max_new_tokens - cached_steps
+    if remaining > 0:
+
+        def step(carry, step_rng):
+            window, pad_count, m, finished = carry
+            logits = model.apply(
+                {"params": params}, window, pad_count, m, method=_decode_forward
+            )
+            token = sample_logits(step_rng, logits, config.sampling)
+            window, pad_count, finished, token, m = advance(
+                window, pad_count, finished, token, m
+            )
+            return (window, pad_count, m, finished), token
+
+        carry = (window, pad_count, m0, finished)
+        _, tokens = jax.lax.scan(step, carry, step_rngs[cached_steps:])
+        token_blocks.append(tokens)
+
+    return jnp.concatenate(token_blocks, axis=0).T.astype(input_ids.dtype)

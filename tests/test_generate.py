@@ -177,3 +177,41 @@ class TestSamplers:
         logits = jnp.log(jnp.asarray([[0.9, 0.1]]))
         out = np.asarray(apply_top_p(logits, 0.5))
         assert np.isfinite(out[0, 0])
+
+
+class TestKVCacheEquivalence:
+    """The cached latent-growth fast path must match windowed recompute
+    exactly (same weights, same rng stream)."""
+
+    @pytest.mark.parametrize(
+        "prompt_len,num_latents,new_tokens",
+        [
+            (4, 2, 4),    # fully inside the cached phase
+            (4, 2, 20),   # cached phase then recompute tail
+            (12, 8, 12),  # cache ineligible from the start (m == max_latents)
+        ],
+    )
+    def test_cache_matches_recompute(self, models, prompt_len, num_latents, new_tokens):
+        _, j_model, params = models
+        ids = jnp.asarray(
+            np.random.default_rng(3).integers(1, KW["vocab_size"], (2, prompt_len))
+        )
+        cfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+        cached = generate(j_model, params, ids, cfg, use_cache=True)
+        recomputed = generate(j_model, params, ids, cfg, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomputed))
+
+    def test_cache_with_ragged_prompts_and_sampling(self, models):
+        _, j_model, params = models
+        ids = jnp.asarray([[0, 0, 5, 6, 7], [2, 3, 4, 5, 6]], jnp.int32)
+        pad = jnp.asarray([2, 0], jnp.int32)
+        cfg = GenerationConfig(
+            max_new_tokens=6, num_latents=2,
+            sampling=SamplingConfig(temperature=0.8, top_k=8),
+        )
+        rng = jax.random.PRNGKey(7)
+        cached = generate(j_model, params, ids, cfg, rng=rng, prompt_pad_count=pad)
+        recomputed = generate(
+            j_model, params, ids, cfg, rng=rng, prompt_pad_count=pad, use_cache=False
+        )
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomputed))
