@@ -67,15 +67,9 @@ def training_flops(cfg: CausalLanguageModelConfig, batch: int) -> float:
     return 3.0 * batch * fwd
 
 
-def main() -> None:
-    devices = jax.devices()
-    mesh = single_device_mesh(devices[0])
-    model = CausalLanguageModel(CFG, dtype=jnp.bfloat16)
+def _build(mesh, attention_impl: str):
+    model = CausalLanguageModel(CFG, dtype=jnp.bfloat16, attention_impl=attention_impl)
     prefix_len = CFG.max_seq_len - CFG.max_latents
-
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, CFG.vocab_size, size=(BATCH, CFG.max_seq_len + 1), dtype=np.int32)
-    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
     def init():
         return model.init(
@@ -85,13 +79,31 @@ def main() -> None:
     tx = optax.adamw(3e-4)
     state, shardings = create_train_state(init, tx, mesh)
     step = make_train_step(clm_loss_fn(model, CFG.max_latents), mesh, shardings)
+    return state, step
+
+
+def main() -> None:
+    devices = jax.devices()
+    mesh = single_device_mesh(devices[0])
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, CFG.vocab_size, size=(BATCH, CFG.max_seq_len + 1), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
     with mesh:
         sharded = shard_batch(batch, mesh)
         key = jax.random.PRNGKey(1)
-        # Warmup / compile.
-        state, metrics = step(state, sharded, key)
-        jax.block_until_ready(metrics["loss"])
+        # Warmup / compile; if the Pallas flash path fails to compile on this
+        # backend, fall back to the XLA einsum attention rather than dying.
+        try:
+            state, step = _build(mesh, "auto")
+            state, metrics = step(state, sharded, key)
+            jax.block_until_ready(metrics["loss"])
+        except Exception as e:
+            print(f"# flash attention unavailable ({type(e).__name__}); xla path", flush=True)
+            state, step = _build(mesh, "xla")
+            state, metrics = step(state, sharded, key)
+            jax.block_until_ready(metrics["loss"])
         # Timed steps.
         n_steps = 10
         t0 = time.perf_counter()
