@@ -20,6 +20,7 @@ long-context work (``docs/training-examples.md:158-162`` scale).
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -100,7 +101,13 @@ def main() -> None:
             state, metrics = step(state, sharded, key)
             jax.block_until_ready(metrics["loss"])
         except Exception as e:
-            print(f"# flash attention unavailable ({type(e).__name__}); xla path", flush=True)
+            print(
+                f"flash-attention path failed ({type(e).__name__}: {e}); "
+                "retrying with xla attention",
+                file=sys.stderr,
+                flush=True,
+            )
+            state = step = metrics = None  # release device buffers before rebuild
             state, step = _build(mesh, "xla")
             state, metrics = step(state, sharded, key)
             jax.block_until_ready(metrics["loss"])
