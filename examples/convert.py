@@ -1,0 +1,64 @@
+"""Checkpoint conversion CLI — parity with the reference's ``examples/convert.py``:
+import torch checkpoints (reference Lightning ``.ckpt`` state dicts or HF
+``pytorch_model.bin``/safetensors state dicts) into a TPU-native
+``save_pretrained`` dir.
+
+    python examples/convert.py clm path/to/state_dict.pt out_dir \
+        --vocab-size 262 --max-seq-len 4096 --max-latents 512
+
+The state-dict key mapping lives in ``perceiver_io_tpu/convert/torch_import.py``
+(one import_* function per task family, each parity-tested against the
+reference models in ``tests/test_torch_parity.py``).
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("task", choices=["clm", "mlm", "sam"])
+    parser.add_argument("state_dict", help="torch .pt/.ckpt file")
+    parser.add_argument("out_dir")
+    parser.add_argument("--vocab-size", type=int, default=262)
+    parser.add_argument("--max-seq-len", type=int, default=4096)
+    parser.add_argument("--max-latents", type=int, default=512)
+    parser.add_argument("--num-channels", type=int, default=512)
+    parser.add_argument("--num-layers", type=int, default=8)
+    args = parser.parse_args()
+
+    import torch
+
+    import perceiver_io_tpu.convert as convert
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    sd = torch.load(args.state_dict, map_location="cpu", weights_only=True)
+    if "state_dict" in sd:  # Lightning checkpoint wrapper
+        sd = sd["state_dict"]
+
+    if args.task in ("clm", "sam"):
+        if args.task == "clm":
+            from perceiver_io_tpu.models.text.clm import CausalLanguageModelConfig as Cfg
+
+            importer = convert.import_causal_language_model
+        else:
+            from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModelConfig as Cfg
+
+            importer = convert.import_symbolic_audio_model
+        cfg = Cfg(
+            vocab_size=args.vocab_size,
+            max_seq_len=args.max_seq_len,
+            max_latents=args.max_latents,
+            num_channels=args.num_channels,
+            num_self_attention_layers=args.num_layers,
+        )
+        params = importer(sd, cfg)
+    else:
+        raise SystemExit("mlm conversion needs encoder/decoder configs; use the API directly")
+
+    save_pretrained(args.out_dir, params, cfg)
+    print(f"saved {args.task} model to {args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()

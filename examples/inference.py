@@ -1,0 +1,84 @@
+"""Inference pipeline demos — parity with the reference's
+``examples/inference.ipynb``: one snippet per pipeline surface, each loading
+a ``save_pretrained`` dir produced by training or ``examples/convert.py``.
+
+Run individual demos:  python examples/inference.py text-generation logs/clm/export
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def text_generation(model_dir: str) -> None:
+    from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+
+    pipe = pipeline_from_pretrained(
+        "text-generation", model_dir, ByteTokenizer(padding_side="left")
+    )
+    print(pipe("A man walked into", max_new_tokens=64, num_latents=64, top_k=40)[0])
+
+
+def fill_mask(model_dir: str) -> None:
+    from perceiver_io_tpu.data.text.preprocessor import TextPreprocessor
+    from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+
+    prep = TextPreprocessor(ByteTokenizer(), max_seq_len=2048)
+    pipe = pipeline_from_pretrained("fill-mask", model_dir, prep)
+    print(pipe("I watched this <mask> and it was awesome", top_k=5))
+
+
+def sentiment(model_dir: str) -> None:
+    from perceiver_io_tpu.data.text.preprocessor import TextPreprocessor
+    from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+
+    prep = TextPreprocessor(ByteTokenizer(), max_seq_len=2048)
+    pipe = pipeline_from_pretrained("sentiment-analysis", model_dir, prep)
+    print(pipe(["I admire this movie", "terrible, save your money"]))
+
+
+def image_classification(model_dir: str) -> None:
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+
+    pipe = pipeline_from_pretrained("image-classification", model_dir)
+    images = np.random.default_rng(0).integers(0, 256, (2, 28, 28), dtype=np.uint8)
+    print(pipe(images, top_k=3))
+
+
+def optical_flow(model_dir: str) -> None:
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+
+    pipe = pipeline_from_pretrained("optical-flow", model_dir, render=True)
+    rng = np.random.default_rng(0)
+    frame1 = rng.integers(0, 256, (368, 496, 3), dtype=np.uint8)
+    frame2 = np.roll(frame1, 4, axis=1)
+    print(pipe((frame1, frame2)).shape)  # (368, 496, 3) rendered RGB
+
+
+def symbolic_audio(model_dir: str) -> None:
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+
+    pipe = pipeline_from_pretrained("symbolic-audio-generation", model_dir)
+    prompt = np.asarray([60, 256 + 49, 128 + 60], np.int32)  # C4 quarter note
+    events = pipe(prompt, max_new_tokens=512, num_latents=1, top_p=0.95)[0]
+    print(f"generated {len(events)} events")
+    # pipe.generate_midi(prompt, path="out.mid")  # requires pretty_midi
+
+
+DEMOS = {
+    "text-generation": text_generation,
+    "fill-mask": fill_mask,
+    "sentiment-analysis": sentiment,
+    "image-classification": image_classification,
+    "optical-flow": optical_flow,
+    "symbolic-audio-generation": symbolic_audio,
+}
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3 or sys.argv[1] not in DEMOS:
+        raise SystemExit(f"usage: python examples/inference.py {{{'|'.join(DEMOS)}}} <model_dir>")
+    DEMOS[sys.argv[1]](sys.argv[2])

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Perceiver IO MNIST classifier — reference examples/training/img_clf.
+python -m perceiver_io_tpu.scripts.vision.image_classifier fit \
+  --data=mnist \
+  --data.batch_size=128 \
+  --model.num_latents=32 \
+  --model.num_latent_channels=128 \
+  --optimizer.lr=1e-3 \
+  --trainer.max_steps=5000 \
+  --trainer.default_root_dir=logs/img_clf

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Perceiver IO masked LM (UTF-8 bytes) — reference examples/training/mlm/train.sh.
+python -m perceiver_io_tpu.scripts.text.mlm fit \
+  --data=wikitext \
+  --data.dataset_dir=.cache/wikitext \
+  --data.task=mlm \
+  --data.max_seq_len=2048 \
+  --data.batch_size=32 \
+  --model.num_latents=256 \
+  --model.num_latent_channels=1280 \
+  --optimizer.lr=1e-4 \
+  --lr_scheduler.warmup_steps=1000 \
+  --trainer.max_steps=50000 \
+  --trainer.default_root_dir=logs/mlm

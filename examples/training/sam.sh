@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Perceiver AR symbolic audio on GiantMIDI — reference examples/training/sam.
+python -m perceiver_io_tpu.scripts.audio.symbolic fit \
+  --data=giantmidi \
+  --data.dataset_dir=.cache/giantmidi \
+  --data.max_seq_len=6144 \
+  --data.min_seq_len=4096 \
+  --data.batch_size=8 \
+  --model.max_latents=2048 \
+  --model.num_channels=768 \
+  --optimizer.lr=2e-4 \
+  --trainer.max_steps=50000 \
+  --trainer.default_root_dir=logs/sam

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Two-stage IMDb classifier — reference examples/training/txt_clf:
+# stage 1: frozen pretrained MLM encoder, train decoder only.
+python -m perceiver_io_tpu.scripts.text.classifier fit \
+  --data=imdb \
+  --data.dataset_dir=.cache/imdb \
+  --data.task=clf \
+  --model.encoder.params=logs/mlm/checkpoints/best \
+  --model.encoder.freeze=true \
+  --optimizer.lr=1e-3 \
+  --trainer.max_steps=5000 \
+  --trainer.default_root_dir=logs/txt_clf_stage1
+# stage 2: unfreeze everything and fine-tune.
+python -m perceiver_io_tpu.scripts.text.classifier fit \
+  --data=imdb \
+  --data.dataset_dir=.cache/imdb \
+  --data.task=clf \
+  --model.encoder.params=logs/mlm/checkpoints/best \
+  --model.encoder.freeze=false \
+  --optimizer.lr=5e-5 \
+  --trainer.max_steps=5000 \
+  --trainer.default_root_dir=logs/txt_clf_stage2
