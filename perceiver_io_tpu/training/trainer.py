@@ -49,6 +49,9 @@ class TrainerConfig:
     seed: int = 0
     enable_checkpointing: bool = True
     enable_tensorboard: bool = True
+    #: shard the sequence dim of batches over the ``seq`` mesh axis
+    #: (context parallelism; XLA partitions attention over kv accordingly)
+    shard_seq: bool = False
 
 
 class Trainer:
@@ -165,7 +168,7 @@ class Trainer:
                             "(one-shot generator?); pass a list or a loader"
                         ) from None
                 rng, step_rng = jax.random.split(rng)
-                batch = shard_batch(batch, self.mesh)
+                batch = shard_batch(batch, self.mesh, shard_seq=cfg.shard_seq)
                 self.state, metrics = train_step(self.state, batch, step_rng)
                 window.append(metrics)
 
@@ -232,7 +235,10 @@ class Trainer:
                     and i >= self.config.limit_val_batches
                 ):
                     break
-                metrics = eval_step(self.state, shard_batch(batch, self.mesh))
+                metrics = eval_step(
+                    self.state,
+                    shard_batch(batch, self.mesh, shard_seq=self.config.shard_seq),
+                )
                 for k, v in metrics.items():
                     totals[k] = totals.get(k, 0.0) + float(v)
                 count += 1

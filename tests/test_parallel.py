@@ -64,7 +64,7 @@ def make_batch(rng, batch_size=8):
     return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
 
-def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14):
+def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14, shard_seq=False):
     model = tiny_clm()
     mesh = make_mesh(mesh_config)
     rng = np.random.default_rng(0)
@@ -84,7 +84,7 @@ def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14):
     losses = []
     with mesh:
         for i in range(n_steps):
-            batch = shard_batch(make_batch(rng, batch_size), mesh)
+            batch = shard_batch(make_batch(rng, batch_size), mesh, shard_seq=shard_seq)
             state, metrics = step(state, batch, jax.random.PRNGKey(i))
             losses.append(float(metrics["loss"]))
     return losses, state, mesh
@@ -109,6 +109,23 @@ def baseline():
 )
 def test_sharded_matches_single_device(baseline, mesh_config):
     losses, _, _ = run_steps(mesh_config)
+    np.testing.assert_allclose(losses, baseline, rtol=2e-4)
+
+
+@pytest.mark.parametrize(
+    "mesh_config",
+    [
+        MeshConfig(data=1, fsdp=1, model=1, seq=8),
+        MeshConfig(data=2, fsdp=1, model=1, seq=4),
+        MeshConfig(data=2, fsdp=2, model=1, seq=2),
+    ],
+    ids=["sp8", "dp2xsp4", "dp2xfsdp2xsp2"],
+)
+def test_sequence_parallel_matches_single_device(baseline, mesh_config):
+    """Context parallelism: inputs sharded along the sequence dim over the
+    ``seq`` axis; XLA GSPMD partitions the attention over the kv sequence
+    and inserts the collectives (the reference has no equivalent)."""
+    losses, _, _ = run_steps(mesh_config, shard_seq=True)
     np.testing.assert_allclose(losses, baseline, rtol=2e-4)
 
 
