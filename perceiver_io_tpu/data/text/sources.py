@@ -120,6 +120,23 @@ class BookCorpusDataModule(_HubDataModule):
         return {"train": texts[: len(texts) - n_valid], "valid": texts[len(texts) - n_valid :]}
 
 
+class BookCorpusOpenDataModule(_HubDataModule):
+    """bookcorpusopen: whole books, one record each (reference
+    ``perceiver/data/text/bookcorpusopen.py``)."""
+
+    cache_name = "bookcorpusopen"
+
+    def __init__(self, source_valid_size: float = 0.05, **kwargs):
+        self.source_valid_size = source_valid_size
+        super().__init__(**kwargs)
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        ds = self._load("bookcorpusopen", split="train")
+        texts = self._texts(ds)
+        n_valid = max(1, int(len(texts) * self.source_valid_size))
+        return {"train": texts[: len(texts) - n_valid], "valid": texts[len(texts) - n_valid :]}
+
+
 class WikipediaDataModule(_HubDataModule):
     cache_name = "wikipedia"
 

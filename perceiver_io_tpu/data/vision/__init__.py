@@ -8,6 +8,7 @@ from perceiver_io_tpu.data.vision.image import (
     MNISTDataModule,
     random_crop_and_flip,
 )
+from perceiver_io_tpu.data.vision.imagenet import ImageNetPreprocessor, resize_bilinear
 from perceiver_io_tpu.data.vision.optical_flow import (
     OpticalFlowProcessor,
     render_optical_flow,
@@ -15,6 +16,8 @@ from perceiver_io_tpu.data.vision.optical_flow import (
 
 __all__ = [
     "ImagePreprocessor",
+    "ImageNetPreprocessor",
+    "resize_bilinear",
     "MNISTDataModule",
     "random_crop_and_flip",
     "OpticalFlowProcessor",
