@@ -57,7 +57,10 @@ class ByteTokenizer:
         for i, part in enumerate(text.split(self.mask_token)):
             if i > 0:
                 ids.append(MASK_ID)
-            ids.extend(b + BYTE_OFFSET for b in part.encode("utf-8"))
+            # vectorized byte mapping: the corpus-preproc hot loop
+            ids.extend(
+                (np.frombuffer(part.encode("utf-8"), np.uint8).astype(np.int64) + BYTE_OFFSET).tolist()
+            )
         if add_special_tokens:
             ids = [CLS_ID] + ids + [SEP_ID]
         return ids

@@ -8,21 +8,26 @@ semantics (reference ``perceiver/model/core/lightning.py``,
 - metric logging (TensorBoard when available, JSONL always);
 - rank-0 qualitative sampling callbacks at validation epochs.
 """
+from perceiver_io_tpu.training.callbacks import MaskFillingCallback, TextSamplingCallback
 from perceiver_io_tpu.training.lrs import constant_with_warmup, cosine_with_warmup
 from perceiver_io_tpu.training.optim import make_optimizer
 from perceiver_io_tpu.training.tasks import (
     classifier_loss_fn,
     clm_loss_fn,
+    image_classifier_loss_fn,
     mlm_loss_fn,
 )
 from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
 
 __all__ = [
+    "MaskFillingCallback",
+    "TextSamplingCallback",
     "constant_with_warmup",
     "cosine_with_warmup",
     "make_optimizer",
     "classifier_loss_fn",
     "clm_loss_fn",
+    "image_classifier_loss_fn",
     "mlm_loss_fn",
     "Trainer",
     "TrainerConfig",

@@ -1,0 +1,78 @@
+"""Validation-time qualitative callbacks — parity with the reference's
+rank-0 end-of-validation sampling (generated text, reference
+``clm/lightning.py:113-151``; filled mask predictions rendered to the logger,
+``mlm/lightning.py:77-94``). Callbacks run on process 0 only (the trainer
+gates them) and log through :meth:`Trainer.log_text`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TextSamplingCallback:
+    """Sample continuations from the current weights after every validation
+    pass (causal LM / symbolic audio families)."""
+
+    def __init__(
+        self,
+        model,
+        tokenizer,
+        prompt: str = "A man",
+        *,
+        max_new_tokens: int = 128,
+        num_latents: int = 64,
+        top_k: Optional[int] = 40,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.num_latents = num_latents
+        self.top_k = top_k
+        self.seed = seed
+
+    def __call__(self, trainer, state, step: int, val_metrics: dict) -> None:
+        from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+        from perceiver_io_tpu.inference.samplers import SamplingConfig
+
+        ids = jnp.asarray([self.tokenizer.encode(self.prompt)], jnp.int32)
+        num_latents = min(self.num_latents, ids.shape[1])
+        out = generate(
+            self.model,
+            state.params,
+            ids,
+            GenerationConfig(
+                max_new_tokens=self.max_new_tokens,
+                num_latents=num_latents,
+                pad_token_id=self.tokenizer.pad_token_id or 0,
+                eos_token_id=self.tokenizer.eos_token_id,
+                sampling=SamplingConfig(do_sample=True, top_k=self.top_k),
+            ),
+            rng=jax.random.fold_in(jax.random.PRNGKey(self.seed), step),
+        )
+        text = self.prompt + self.tokenizer.decode(np.asarray(out)[0].tolist())
+        trainer.log_text(step, "samples/generated", text)
+
+
+class MaskFillingCallback:
+    """Fill masked validation samples after every validation pass (MLM
+    family); logs the top-k fillings per sample."""
+
+    def __init__(self, model, preprocessor, masked_samples: Sequence[str], *, top_k: int = 3):
+        self.model = model
+        self.preprocessor = preprocessor
+        self.masked_samples = list(masked_samples)
+        self.top_k = top_k
+
+    def __call__(self, trainer, state, step: int, val_metrics: dict) -> None:
+        from perceiver_io_tpu.inference.mask_filler import MaskFiller
+
+        filler = MaskFiller(self.preprocessor)
+        _, filled = filler.fill(self.model, state.params, self.masked_samples, self.top_k)
+        for sample, fillings in zip(self.masked_samples, filled):
+            trainer.log_text(step, "samples/fill_mask", f"{sample!r} -> {fillings}")

@@ -123,6 +123,16 @@ class Trainer:
             for k, v in scalars.items():
                 self._tb.add_scalar(k, v, step)
 
+    def log_text(self, step: int, tag: str, text: str) -> None:
+        """Qualitative text logging (generated samples, filled masks) — the
+        reference renders these into TensorBoard text panels."""
+        if not self.is_main_process:
+            return
+        self._metrics_file.write(json.dumps({"step": step, tag: text}) + "\n")
+        self._metrics_file.flush()
+        if self._tb is not None:
+            self._tb.add_text(tag, text, step)
+
     def fit(
         self,
         init_params_fn: Callable[[], Any],

@@ -137,3 +137,52 @@ def test_cli_yaml_config_defaults(tmp_path):
         ["fit", "--data=toy", f"--config={cfg_file}", "--trainer.max_steps=2"]
     )
     assert int(state.step) == 2
+
+
+@pytest.mark.slow
+def test_sampling_callback_logs_text(tmp_path):
+    """TextSamplingCallback fires at validation and writes a sample line."""
+    import json
+
+    import optax
+
+    from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+    from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+    from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+    from perceiver_io_tpu.training import TextSamplingCallback
+    from perceiver_io_tpu.training.tasks import clm_loss_fn
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=262, max_seq_len=32, max_latents=16, num_channels=32,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(cfg)
+    tok = ByteTokenizer(padding_side="left")
+
+    import jax
+    import jax.numpy as jnp
+
+    rngnp = np.random.default_rng(0)
+    ids = rngnp.integers(6, 262, (8, 33), dtype=np.int64)
+    batch = {"input_ids": ids[:, :-1].astype(np.int32), "labels": ids[:, 1:].astype(np.int32)}
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=2, val_check_interval=2, log_every_n_steps=2,
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+            enable_tensorboard=False,
+        ),
+        make_mesh(MeshConfig(data=8)),
+        clm_loss_fn(model, cfg.max_latents),
+        optax.adam(1e-3),
+        callbacks=[TextSamplingCallback(model, tok, prompt="hi", max_new_tokens=4, num_latents=2)],
+    )
+    trainer.fit(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.asarray(batch["input_ids"][:1]), 16)["params"],
+        [batch],
+        val_data=lambda: [batch],
+    )
+    trainer.close()
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert any("samples/generated" in l for l in lines)
