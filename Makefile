@@ -1,0 +1,22 @@
+# Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
+PY ?= python
+
+.PHONY: test test-fast cov bench dryrun lint
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+cov:
+	$(PY) -m pytest tests/ -q --cov=perceiver_io_tpu --cov-report=term-missing
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+lint:
+	$(PY) -m compileall -q perceiver_io_tpu tests examples
