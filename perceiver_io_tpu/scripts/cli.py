@@ -213,7 +213,7 @@ class CLI:
     def _known_flags(self, data_cls) -> Dict[str, Any]:
         from perceiver_io_tpu.training.trainer import TrainerConfig
 
-        known: Dict[str, Any] = {"config": str, "data": str}
+        known: Dict[str, Any] = {"config": str, "data": str, "params": str}
         known.update(flag_specs(self.family.config_class, "model", self.family.nested))
         known.update(_ctor_flag_specs(data_cls, "data"))
         known.update(flag_specs(TrainerConfig, "trainer"))
@@ -334,7 +334,13 @@ class CLI:
             ]
 
         initial = None
-        if self.family.initial_params is not None:
+        if values.get("params"):
+            # Full-model warm start from a save_pretrained dir (reference
+            # ``--model.params`` reload, ``clm/lightning.py:44-52``).
+            from perceiver_io_tpu.training.checkpoint import load_pretrained
+
+            initial, _ = load_pretrained(values["params"])
+        elif self.family.initial_params is not None:
             initial = self.family.initial_params(model, model_cfg, dm)
 
         if subcommand == "validate":

@@ -52,6 +52,9 @@ class TrainerConfig:
     #: shard the sequence dim of batches over the ``seq`` mesh axis
     #: (context parallelism; XLA partitions attention over kv accordingly)
     shard_seq: bool = False
+    #: capture a jax.profiler trace of steps [profile_start, profile_start+3)
+    #: into <default_root_dir>/profile (None disables)
+    profile_start: Optional[int] = None
 
 
 class Trainer:
@@ -163,6 +166,7 @@ class Trainer:
 
         data_iter = iter(train_data)
         window: list = []
+        profiling = False
         t0 = time.time()
         with self.mesh:
             for step_idx in range(1, cfg.max_steps + 1):
@@ -179,8 +183,17 @@ class Trainer:
                         ) from None
                 rng, step_rng = jax.random.split(rng)
                 batch = shard_batch(batch, self.mesh, shard_seq=cfg.shard_seq)
+                if cfg.profile_start is not None and step_idx == cfg.profile_start:
+                    jax.profiler.start_trace(
+                        os.path.join(cfg.default_root_dir, "profile")
+                    )
+                    profiling = True
                 self.state, metrics = train_step(self.state, batch, step_rng)
                 window.append(metrics)
+                if profiling and step_idx >= cfg.profile_start + 2:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
 
                 def flush_window(step_idx=step_idx):
                     nonlocal window, t0
@@ -213,6 +226,8 @@ class Trainer:
                         if self.is_main_process:
                             cb(self, self.state, step_idx, val_metrics)
                     t0 = time.time()
+            if profiling:  # max_steps ended inside the capture window
+                jax.profiler.stop_trace()
         return self.state
 
     def setup_state(

@@ -186,3 +186,43 @@ def test_sampling_callback_logs_text(tmp_path):
     trainer.close()
     lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
     assert any("samples/generated" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_cli_params_warm_start(tmp_path):
+    """--params=<save_pretrained dir> warm-starts the full model (reference
+    --model.params reload semantics)."""
+    family = _toy_family()
+    argv = [
+        "--data=toy",
+        f"--data.dataset_dir={tmp_path}/data",
+        "--data.max_seq_len=64",
+        "--data.batch_size=8",
+        "--model.max_latents=32",
+        "--model.num_channels=32",
+        "--model.num_heads=2",
+        "--model.num_self_attention_layers=1",
+        "--model.cross_attention_dropout=0.0",
+        "--trainer.max_steps=1",
+        "--trainer.val_check_interval=5",
+        f"--trainer.default_root_dir={tmp_path}/logs",
+        "--trainer.enable_checkpointing=false",
+        "--trainer.enable_tensorboard=false",
+    ]
+    state = CLI(family).main(["fit", *argv])
+
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    import jax
+
+    saved = tmp_path / "warm"
+    save_pretrained(str(saved), jax.device_get(state.params), None)
+
+    state2 = CLI(family).main(["fit", *argv, f"--params={saved}"])
+    a = jax.device_get(state.params)
+    b = jax.device_get(state2.params)
+    # warm start + 1 more step: embeddings moved but started from `a`
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    metrics = CLI(family).main(["validate", *argv, f"--params={saved}"])
+    assert np.isfinite(metrics["loss"])
