@@ -52,11 +52,34 @@ def load_config(path: str) -> Any:
     return config_from_dict(None, d) if d is not None else None
 
 
+def _trainer_checkpoint_root(path: str) -> Optional[str]:
+    """If ``path`` is (or is ``<root>/best`` of) a trainer checkpoint dir —
+    an orbax CheckpointManager root with numeric step dirs — return the
+    root, else None."""
+    if os.path.basename(path) == "best" and not os.path.exists(path):
+        path = os.path.dirname(path)
+    if not os.path.isdir(path) or os.path.exists(os.path.join(path, PARAMS_DIR)):
+        return None
+    has_steps = any(name.isdigit() for name in os.listdir(path))
+    return path if has_steps else None
+
+
 def load_pretrained(path: str, *, target: Any = None):
     """:return: (params, config). ``target`` — an abstract pytree (e.g. from
     ``jax.eval_shape``) with shardings for direct-to-mesh restore; omit for
-    host restore."""
+    host restore.
+
+    Accepts either a ``save_pretrained`` dir or a trainer checkpoint dir
+    (``<root>/checkpoints`` or the ``<root>/checkpoints/best`` alias), which
+    restores the best-``val_loss`` step."""
     path = os.path.abspath(path)
+    ckpt_root = _trainer_checkpoint_root(path)
+    if ckpt_root is not None:
+        manager = BestCheckpointManager(ckpt_root)
+        try:
+            return manager.restore_best(target=target)
+        finally:
+            manager.close()
     config = load_config(path)
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(os.path.join(path, PARAMS_DIR), target)

@@ -226,3 +226,44 @@ def test_cli_params_warm_start(tmp_path):
     assert len(leaves_a) == len(leaves_b)
     metrics = CLI(family).main(["validate", *argv, f"--params={saved}"])
     assert np.isfinite(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_trainer_checkpoint_loads_as_pretrained(tmp_path):
+    """The best trainer checkpoint loads through load_pretrained /
+    pipeline_from_pretrained (the README's `checkpoints/best` flow)."""
+    family = _toy_family()
+    argv = [
+        "--data=toy",
+        f"--data.dataset_dir={tmp_path}/data",
+        "--data.max_seq_len=64",
+        "--data.batch_size=8",
+        "--model.max_latents=32",
+        "--model.num_channels=32",
+        "--model.num_heads=2",
+        "--model.num_self_attention_layers=1",
+        "--model.cross_attention_dropout=0.0",
+        "--trainer.max_steps=2",
+        "--trainer.val_check_interval=2",
+        f"--trainer.default_root_dir={tmp_path}/logs",
+        "--trainer.enable_tensorboard=false",
+    ]
+    CLI(family).main(["fit", *argv])
+
+    import jax
+
+    from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+    from perceiver_io_tpu.inference import pipeline_from_pretrained
+    from perceiver_io_tpu.training.checkpoint import load_pretrained
+
+    ckpt = f"{tmp_path}/logs/checkpoints"
+    params, config = load_pretrained(ckpt)
+    assert config is not None and config.num_channels == 32
+    params2, _ = load_pretrained(ckpt + "/best")  # alias
+    assert len(jax.tree.leaves(params)) == len(jax.tree.leaves(params2))
+
+    pipe = pipeline_from_pretrained(
+        "text-generation", ckpt + "/best", ByteTokenizer(padding_side="left")
+    )
+    out = pipe("ab", max_new_tokens=3, num_latents=2, temperature=0.0)
+    assert len(out) == 1 and out[0].startswith("ab")
