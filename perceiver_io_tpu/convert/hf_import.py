@@ -1,0 +1,194 @@
+"""Importers for the official DeepMind Hugging Face Perceiver models
+(``transformers.PerceiverForMaskedLM`` = ``deepmind/language-perceiver``,
+``transformers.PerceiverForImageClassificationFourier`` =
+``deepmind/vision-perceiver-fourier``).
+
+Strategy: translate the ``transformers`` state-dict keys into the reference
+library's module layout (the correspondence the reference establishes in its
+``copy_*`` helpers, ``perceiver/model/core/huggingface.py:17-76``,
+``text/common/huggingface.py:12-18``, ``text/mlm/huggingface.py:158-165``,
+``vision/image_classifier/huggingface.py``), then reuse the parity-tested
+reference-layout importers in :mod:`perceiver_io_tpu.convert.torch_import`.
+
+Config conversion mirrors the reference's ``convert_config`` functions
+(``mlm/huggingface.py:116-155``, ``image_classifier/huggingface.py:182-210``).
+
+Oracle: ``tests/test_hf_convert.py`` builds randomly initialized
+``transformers`` models (no hub access) and asserts logit parity.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+from perceiver_io_tpu.convert import torch_import
+
+
+def _expand(module_map: Mapping[str, str], hf_sd: Mapping[str, Any]) -> Dict[str, Any]:
+    """Expand module-path renames to parameter keys present in ``hf_sd``."""
+    out: Dict[str, Any] = {}
+    for hf_base, ref_base in module_map.items():
+        if hf_base in hf_sd:  # bare parameter (latents, position embeddings)
+            out[ref_base] = hf_sd[hf_base]
+            continue
+        for suffix in (".weight", ".bias"):
+            if hf_base + suffix in hf_sd:
+                out[ref_base + suffix] = hf_sd[hf_base + suffix]
+    return out
+
+
+def _layer_map(hf: str, ref: str, *, residual: bool = True, self_attn: bool = False) -> Dict[str, str]:
+    """transformers ``PerceiverLayer`` → reference
+    CrossAttentionLayer/SelfAttentionLayer module paths (reference
+    ``core/huggingface.py:26-57``)."""
+    pre = f"{ref}.0.module" if residual else f"{ref}.0"
+    m: Dict[str, str] = {}
+    if self_attn:
+        m[f"{hf}.attention.self.layernorm1"] = f"{pre}.norm"
+    else:
+        m[f"{hf}.attention.self.layernorm1"] = f"{pre}.q_norm"
+        m[f"{hf}.attention.self.layernorm2"] = f"{pre}.kv_norm"
+    m[f"{hf}.attention.self.query"] = f"{pre}.attention.q_proj"
+    m[f"{hf}.attention.self.key"] = f"{pre}.attention.k_proj"
+    m[f"{hf}.attention.self.value"] = f"{pre}.attention.v_proj"
+    m[f"{hf}.attention.output.dense"] = f"{pre}.attention.o_proj"
+    # reference MLP = Sequential(LayerNorm, Linear, GELU, Linear)
+    m[f"{hf}.layernorm"] = f"{ref}.1.module.0"
+    m[f"{hf}.mlp.dense1"] = f"{ref}.1.module.1"
+    m[f"{hf}.mlp.dense2"] = f"{ref}.1.module.3"
+    return m
+
+
+def _encoder_map(num_self_attention_layers: int) -> Dict[str, str]:
+    m = {"perceiver.embeddings.latents": "encoder.latent_provider._query"}
+    m.update(_layer_map("perceiver.encoder.cross_attention", "encoder.cross_attn_1"))
+    for i in range(num_self_attention_layers):
+        m.update(
+            _layer_map(
+                f"perceiver.encoder.self_attends.{i}",
+                f"encoder.self_attn_1.{i}",
+                self_attn=True,
+            )
+        )
+    return m
+
+
+# -- masked language model -------------------------------------------------
+def mlm_config_from_hf(config) -> Any:
+    """``transformers.PerceiverConfig`` → :data:`MaskedLanguageModelConfig`
+    (reference ``mlm/huggingface.py:116-155``)."""
+    from perceiver_io_tpu.models.core.config import PerceiverIOConfig
+    from perceiver_io_tpu.models.text.common import TextEncoderConfig
+    from perceiver_io_tpu.models.text.mlm import TextDecoderConfig
+
+    assert config.hidden_act == "gelu"
+    assert config.tie_word_embeddings
+    encoder = TextEncoderConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        num_input_channels=config.d_model,
+        num_cross_attention_qk_channels=config.qk_channels,
+        num_cross_attention_v_channels=config.v_channels,
+        num_cross_attention_heads=config.num_cross_attention_heads,
+        num_self_attention_qk_channels=config.qk_channels,
+        num_self_attention_v_channels=config.v_channels,
+        num_self_attention_heads=config.num_self_attention_heads,
+        num_self_attention_layers_per_block=config.num_self_attends_per_block,
+        num_self_attention_blocks=config.num_blocks,
+        cross_attention_widening_factor=config.cross_attention_widening_factor,
+        self_attention_widening_factor=config.self_attention_widening_factor,
+        dropout=config.attention_probs_dropout_prob,
+        init_scale=config.initializer_range,
+    )
+    decoder = TextDecoderConfig(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_position_embeddings,
+        num_cross_attention_qk_channels=config.qk_channels,
+        num_cross_attention_v_channels=config.d_model,
+        num_cross_attention_heads=config.num_cross_attention_heads,
+        cross_attention_widening_factor=config.cross_attention_widening_factor,
+        cross_attention_residual=False,
+        dropout=config.attention_probs_dropout_prob,
+        init_scale=config.initializer_range,
+    )
+    return PerceiverIOConfig(
+        encoder,
+        decoder,
+        num_latents=config.num_latents,
+        num_latent_channels=config.d_latents,
+    )
+
+
+def import_hf_masked_language_model(hf_state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """``PerceiverForMaskedLM`` state dict → flax params."""
+    m = _encoder_map(config.encoder.num_self_attention_layers_per_block)
+    m.update(
+        {
+            "perceiver.input_preprocessor.embeddings": "encoder.input_adapter.txt_embedding",
+            "perceiver.input_preprocessor.position_embeddings": "encoder.input_adapter.pos_embedding",
+            "perceiver.decoder.output_position_encodings.position_embeddings":
+                "decoder.output_query_provider._query",
+            "embedding_decoder.bias": "decoder.output_adapter.bias",
+        }
+    )
+    m.update(
+        _layer_map(
+            "perceiver.decoder.decoding_cross_attention", "decoder.cross_attn",
+            residual=config.decoder.cross_attention_residual,
+        )
+    )
+    ref_sd = _expand(m, hf_state_dict)
+    return torch_import.import_masked_language_model(ref_sd, config)
+
+
+# -- image classifier (fourier) --------------------------------------------
+def image_classifier_config_from_hf(config) -> Any:
+    """``transformers.PerceiverConfig`` → :data:`ImageClassifierConfig`
+    (reference ``image_classifier/huggingface.py:182-210``)."""
+    from perceiver_io_tpu.models.core.config import (
+        ClassificationDecoderConfig,
+        PerceiverIOConfig,
+    )
+    from perceiver_io_tpu.models.vision.image_classifier import ImageEncoderConfig
+
+    assert config.hidden_act == "gelu"
+    encoder = ImageEncoderConfig(
+        image_shape=(224, 224, 3),
+        num_frequency_bands=64,
+        num_cross_attention_heads=config.num_cross_attention_heads,
+        num_self_attention_heads=config.num_self_attention_heads,
+        num_self_attention_layers_per_block=config.num_self_attends_per_block,
+        num_self_attention_blocks=config.num_blocks,
+        dropout=config.attention_probs_dropout_prob,
+        init_scale=config.initializer_range,
+    )
+    decoder = ClassificationDecoderConfig(
+        num_classes=config.num_labels,
+        num_output_query_channels=config.d_latents,
+        num_cross_attention_heads=config.num_cross_attention_heads,
+        cross_attention_residual=True,
+        dropout=config.attention_probs_dropout_prob,
+        init_scale=config.initializer_range,
+    )
+    return PerceiverIOConfig(
+        encoder,
+        decoder,
+        num_latents=config.num_latents,
+        num_latent_channels=config.d_latents,
+    )
+
+
+def import_hf_image_classifier(hf_state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """``PerceiverForImageClassificationFourier`` state dict → flax params."""
+    m = _encoder_map(config.encoder.num_self_attention_layers_per_block)
+    m.update(
+        _layer_map("perceiver.decoder.decoder.decoding_cross_attention", "decoder.cross_attn")
+    )
+    m.update(
+        {
+            "perceiver.decoder.decoder.output_position_encodings.position_embeddings":
+                "decoder.output_query_provider._query",
+            "perceiver.decoder.decoder.final_layer": "decoder.output_adapter.linear",
+        }
+    )
+    ref_sd = _expand(m, hf_state_dict)
+    return torch_import.import_image_classifier(ref_sd, config)
