@@ -1,0 +1,98 @@
+"""Compute-optimal analysis over a scaling-study ``summary.csv``.
+
+Fits the reference's power-law allocation (reference
+``examples/scaling/clm/scaling/laws.py``, Chinchilla-style exponents) over
+the runs on the loss-vs-compute frontier, prints the fitted law and the
+optimal (N, D) for a list of target budgets, and optionally renders the
+loss-vs-compute plot (``--plot out.png``; matplotlib required only then).
+
+Usage::
+
+    python examples/scaling/analyze.py data/summary.csv --budgets 1e15 1e16
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+
+from perceiver_io_tpu.utils.flops import fit_scaling_law
+
+
+def load_summary(path: str):
+    with open(path, newline="") as f:
+        return [
+            {
+                **row,
+                "params": float(row["params"]),
+                "flops": float(row["flops"]),
+                "tokens": float(row["tokens"]),
+                "val_loss": float(row["val_loss"]),
+            }
+            for row in csv.DictReader(f)
+        ]
+
+
+def frontier(rows):
+    """Runs not dominated by a cheaper-and-better run (loss-vs-compute)."""
+    rows = sorted(rows, key=lambda r: r["flops"])
+    best, out = float("inf"), []
+    for r in rows:
+        if r["val_loss"] < best:
+            best = r["val_loss"]
+            out.append(r)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("summary")
+    p.add_argument("--a", type=float, default=0.5, help="N_opt exponent")
+    p.add_argument("--b", type=float, default=0.5, help="D_opt exponent")
+    p.add_argument("--budgets", type=float, nargs="*", default=[])
+    p.add_argument("--plot", default=None, help="write loss-vs-compute PNG here")
+    args = p.parse_args()
+
+    rows = load_summary(args.summary)
+    front = frontier(rows)
+    law = fit_scaling_law(
+        [r["flops"] for r in front],
+        [r["params"] for r in front],
+        [r["tokens"] for r in front],
+        a=args.a,
+        b=args.b,
+    )
+    print(law)
+    for c in args.budgets:
+        print(f"C = {c:.3e}:  N_opt = {law.n_opt(c):.3e}  D_opt = {law.d_opt(c):.3e}")
+
+    if args.plot:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for r in rows:
+            ax.scatter(r["flops"], r["val_loss"], color="tab:blue")
+            ax.annotate(
+                f"c{int(r['num_channels'])}/l{int(r['num_layers'])}",
+                (r["flops"], r["val_loss"]),
+                fontsize=7,
+            )
+        ax.plot(
+            [r["flops"] for r in front],
+            [r["val_loss"] for r in front],
+            color="tab:orange",
+            label="frontier",
+        )
+        ax.set_xscale("log")
+        ax.set_xlabel("training FLOPs")
+        ax.set_ylabel("val loss")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(args.plot, dpi=150)
+        print(f"wrote {args.plot}")
+
+
+if __name__ == "__main__":
+    main()

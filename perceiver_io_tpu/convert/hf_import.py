@@ -99,12 +99,17 @@ def mlm_config_from_hf(config) -> Any:
         dropout=config.attention_probs_dropout_prob,
         init_scale=config.initializer_range,
     )
+    # transformers.PerceiverForMaskedLM hardcodes its decoder attention shape
+    # (qk_channels=8*32, num_heads=8, v_channels=d_model) regardless of the
+    # PerceiverConfig (transformers modeling_perceiver.py, PerceiverForMaskedLM
+    # __init__) — the reference's convert_config gets away with config.qk_channels
+    # only because the official checkpoint happens to have qk_channels=256.
     decoder = TextDecoderConfig(
         vocab_size=config.vocab_size,
         max_seq_len=config.max_position_embeddings,
-        num_cross_attention_qk_channels=config.qk_channels,
+        num_cross_attention_qk_channels=8 * 32,
         num_cross_attention_v_channels=config.d_model,
-        num_cross_attention_heads=config.num_cross_attention_heads,
+        num_cross_attention_heads=8,
         cross_attention_widening_factor=config.cross_attention_widening_factor,
         cross_attention_residual=False,
         dropout=config.attention_probs_dropout_prob,

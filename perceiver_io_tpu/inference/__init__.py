@@ -1,13 +1,14 @@
 """Inference layer — autoregressive generation as a single compile-once
 ``lax.scan`` that keeps the whole decode loop on-device (the reference
 re-dispatches a Python-driven full forward per token, reference
-``perceiver/model/text/clm/huggingface.py:53-74``), plus logit samplers and
-MLM mask filling. A cached-decode fast path for the latent-growth phase is
-the planned perf-pass follow-up (see ``generate.py`` docstring for why exact
-caching interacts with the prefix/latent boundary).
+``perceiver/model/text/clm/huggingface.py:53-74``), plus beam search, logit
+samplers and MLM mask filling. Cached decode covers the latent-growth phase
+incrementally and the prefix-growth phase via a cross-k/v cache with per-step
+boundary migration (see ``generate.py`` docstring for the phase analysis).
 """
 from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
-from perceiver_io_tpu.inference.generate import generate
+from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+from perceiver_io_tpu.inference.beam import beam_search
 from perceiver_io_tpu.inference.mask_filler import MaskFiller
 from perceiver_io_tpu.inference.pipelines import (
     FillMaskPipeline,
@@ -24,6 +25,8 @@ __all__ = [
     "SamplingConfig",
     "sample_logits",
     "generate",
+    "GenerationConfig",
+    "beam_search",
     "MaskFiller",
     "pipeline",
     "pipeline_from_pretrained",

@@ -23,6 +23,34 @@ The prefix/latent boundary feeds the computation in two places that a KV
 cache must respect: boundary-side key normalization (prefix keys use
 ``kv_norm``, latent keys use ``q_norm`` — reference ``modules.py:188-203``)
 and latent-stack membership. Both are masked dynamically here.
+
+Cache coverage by phase (``use_cache=True`` spans all of ``max_new_tokens``
+in a single chained-scan program):
+
+1. **Latent growth** (``_decode_step``): fully incremental — only the new
+   token runs through the model, attending over cross- and per-layer stack
+   caches. O(1) tokens of compute per step.
+2. **Prefix growth** (``_decode_step_boundary``): token positions are stable
+   (the window still slides over left pads), but the latent/prefix boundary
+   migrates one position per step: the oldest latent becomes prefix, so its
+   cross k/v are recomputed ``kv_norm``-side and overwritten in the cache
+   (reference ``modules.py:188-203``). Because every latent attends to the
+   migrated key, all latent cross-attention outputs — and therefore the
+   whole self-attention stack — change each step and are recomputed; what
+   the cache elides is the full-window embedding + cross k/v projections
+   (the ``2·n·c²`` matmuls, the dominant projection cost for ``n ≫ m``).
+3. **Sliding window** (``_decode_forward`` recompute): with the reference's
+   learned absolute position embedding (``abs_pos_emb=True``, the default),
+   incremental caching in this phase is *semantically impossible*, not
+   merely hard: positions are window-relative (reference
+   ``clm/huggingface.py:66`` truncates to the last ``max_seq_len`` tokens),
+   so every surviving token's position embedding — and hence every key,
+   value, and latent input — changes on every step. The only exact step is
+   a full recompute, which is what the reference itself does each token;
+   here it stays inside ``lax.scan``, compiled once. (For a rotary-only
+   model, ``abs_pos_emb=False``, positions enter attention only relatively
+   and a stable-angle cache would be mathematically exact — but not
+   bit-exact against the window-relative recompute, so it is not used.)
 """
 from __future__ import annotations
 
@@ -31,6 +59,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
 from perceiver_io_tpu.ops.position import RotaryEmbedding, positions
@@ -43,6 +72,13 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     sampling: SamplingConfig = SamplingConfig()
+    #: beam width; > 1 dispatches :func:`generate` to beam search (greedy
+    #: candidate expansion, HF ``GenerationMixin`` semantics).
+    num_beams: int = 1
+    #: HF exponent on hypothesis length (prompt + generated) when ranking.
+    length_penalty: float = 1.0
+    #: EOS is masked to -inf until this many new tokens exist (beam search).
+    min_new_tokens: int = 0
 
 
 def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.ndarray):
@@ -237,6 +273,95 @@ def _decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray, m: j
     return logits, cache, length + 1, m + 1
 
 
+def _decode_step_boundary(
+    mdl, window: jnp.ndarray, pad_count: jnp.ndarray, cross_k, cross_v, length
+):
+    """One cached decode step for the **prefix-growth** phase (the latent
+    count is pinned at ``max_latents`` and the boundary migrates one position
+    per step — reference window schedule ``clm/huggingface.py:56-62``).
+
+    Token positions are stable in this phase (every row still slides over
+    left pads), so the abs-indexed cross k/v cache stays valid except at two
+    positions, which are (re)projected per step:
+
+    - the **new token** enters as the freshest latent (``q_norm``-side k/v,
+      appended at index ``length``);
+    - the **oldest latent** (abs index ``n - max_latents - 1 - pad_count``)
+      becomes prefix — its k/v are recomputed ``kv_norm``-side (the
+      boundary-side normalization swap, reference ``modules.py:188-203``).
+
+    Every latent attends to the migrated key, so all latent cross-attention
+    outputs and the self-attention stack are recomputed (their inputs
+    changed); the cache elides the ``2·n·c²`` full-window k/v projections
+    and the full-window embedding. The attend itself runs over the cache
+    gathered back into window-slot alignment so the computation — including
+    masking — is bitwise identical to :func:`_decode_forward`.
+
+    :param window: ``(b, N)`` tokens, right-aligned (new token last).
+    :param pad_count: ``(b,)`` left-pad counts *after* the append.
+    :param cross_k/cross_v: ``(b, h, N, d)`` abs-indexed cross k/v cache.
+    :param length: ``(b,)`` real-token count *before* the append.
+    :return: (next-token logits, cross_k, cross_v, length + 1).
+    """
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    rows = jnp.arange(b)
+
+    # Latent segment: the last max_latents window slots, all real tokens
+    # (guaranteed by the caller's phase-2 precondition).
+    lat_abs = jnp.maximum(
+        jnp.arange(n - num_latents, n)[None, :] - pad_count[:, None], 0
+    )
+    emb_lat, frq_lat = ar.input_adapter(window[:, n - num_latents :], abs_pos=lat_abs)
+    x_q_lat = ca.q_norm(emb_lat)
+
+    # Boundary migration: recompute the ex-latent's k/v kv_norm-side.
+    mig_abs = jnp.maximum((n - num_latents - 1) - pad_count[:, None], 0)
+    emb_mig, frq_mig = ar.input_adapter(
+        window[:, n - num_latents - 1 : n - num_latents], abs_pos=mig_abs
+    )
+    k_mig, v_mig = mha.project_kv(ca.kv_norm(emb_mig), RotaryEmbedding(frq_mig))
+    cross_k = cross_k.at[rows, :, mig_abs[:, 0]].set(k_mig[:, :, 0])
+    cross_v = cross_v.at[rows, :, mig_abs[:, 0]].set(v_mig[:, :, 0])
+
+    # Append the new token's q_norm-side k/v at its abs index.
+    k_new, v_new = mha.project_kv(
+        x_q_lat[:, -1:], RotaryEmbedding(frq_lat[:, -1:])
+    )
+    cross_k = cross_k.at[rows, :, length].set(k_new[:, :, 0])
+    cross_v = cross_v.at[rows, :, length].set(v_new[:, :, 0])
+
+    # Gather the abs-indexed cache into window-slot alignment and attend
+    # exactly as the uncached forward does (pad slots gather garbage that the
+    # pad mask zeroes out of the softmax).
+    slot_abs = jnp.maximum(jnp.arange(n)[None, :] - pad_count[:, None], 0)
+    k_slots = jnp.take_along_axis(cross_k, slot_abs[:, None, :, None], axis=2)
+    v_slots = jnp.take_along_axis(cross_v, slot_abs[:, None, :, None], axis=2)
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    q = mha.project_q(x_q_lat, RotaryEmbedding(frq_lat, right_align=True))
+    attn = mha.attend(q, k_slots, v_slots, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb_lat
+    x = layer.mlp(x) + x
+
+    # Full self-attention stack over the max_latents latents (all real; the
+    # all-False mask keeps the masking ops bitwise identical to
+    # _decode_forward with m == max_latents).
+    stack_pad = jnp.zeros((b, num_latents), bool)
+    x = ar.self_attention(
+        x, stack_pad, RotaryEmbedding(frq_lat, right_align=True), True
+    )
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    return logits, cross_k, cross_v, length + 1
+
+
 def generate(
     model,
     params,
@@ -254,6 +379,18 @@ def generate(
     :param prompt_pad_count: ``(b,)`` left-pad counts for ragged prompts.
     :return: ``(b, max_new_tokens)`` generated ids (pad after EOS).
     """
+    if config.num_beams > 1:
+        from perceiver_io_tpu.inference.beam import beam_search
+
+        return beam_search(
+            model,
+            params,
+            input_ids,
+            config,
+            num_beams=config.num_beams,
+            length_penalty=config.length_penalty,
+            prompt_pad_count=prompt_pad_count,
+        )
     b, prompt_len = input_ids.shape
     n = model.max_seq_len
     max_latents = model.max_latents
@@ -292,24 +429,35 @@ def generate(
         m = jnp.minimum(m + 1, max_latents)
         return window, pad_count, finished, token, m
 
-    # Cached fast path: valid while every generated token is a *fresh* latent
-    # and the window still slides over left pads — the latent-growth phase.
-    # Afterwards the latent/prefix boundary migrates per step (reference
-    # window schedule, ``clm/huggingface.py:53-74``), which invalidates
-    # per-position caches, so the tail falls back to windowed recompute.
-    cached_steps = (
+    # Phase schedule (see module docstring). Phase 1 (latent growth) is
+    # fully incremental; phase 2 (prefix growth) reuses the cross k/v cache
+    # with per-step boundary migration — valid only while pads never occupy
+    # latent slots (prompt pads fit in the nominal prefix); phase 3 (slide)
+    # is windowed recompute, semantically forced by the learned absolute
+    # position embedding (reference window schedule ``clm/huggingface.py:
+    # 53-74``).
+    s1 = (
         min(config.max_new_tokens, max_latents - num_latents, n - prompt_len)
         if use_cache
         else 0
     )
+    phase2_ok = use_cache and bool(
+        (np.asarray(jax.device_get(prompt_pad_count)) <= prefix_len).all()
+    )
+    s2 = min(config.max_new_tokens, n - prompt_len) if phase2_ok else s1
+    s2 = max(s1, s2)
+
     token_blocks = []
     m0 = jnp.asarray(num_latents, jnp.int32)
     finished = jnp.zeros((b,), bool)
+    cache = length = logits = None
 
-    if cached_steps > 0:
-        logits, cache, length, m = model.apply(
+    if s2 > 0:
+        logits, cache, length, _ = model.apply(
             {"params": params}, window, pad_count, m0, method=_decode_prefill
         )
+
+    if s1 > 0:
 
         def cached_step(carry, step_rng):
             window, pad_count, finished, logits, cache, length, m = carry
@@ -323,13 +471,38 @@ def generate(
             return (window, pad_count, finished, logits, cache, length, m), token
 
         carry = (window, pad_count, finished, logits, cache, length, m0)
-        carry, tokens = jax.lax.scan(cached_step, carry, step_rngs[:cached_steps])
-        window, pad_count, finished = carry[0], carry[1], carry[2]
-        m0 = carry[6]
+        carry, tokens = jax.lax.scan(cached_step, carry, step_rngs[:s1])
+        window, pad_count, finished, logits, cache, length, m0 = carry
         token_blocks.append(tokens)
 
-    remaining = config.max_new_tokens - cached_steps
-    if remaining > 0:
+    if s2 > s1:
+        cross_k, cross_v = cache["cross_k"], cache["cross_v"]
+        m_full = jnp.asarray(max_latents, jnp.int32)
+
+        def boundary_step(carry, step_rng):
+            window, pad_count, finished, logits, cross_k, cross_v, length = carry
+            token = sample_logits(step_rng, logits, config.sampling)
+            window, pad_count, finished, token, _ = advance(
+                window, pad_count, finished, token, m_full
+            )
+            logits, cross_k, cross_v, length = model.apply(
+                {"params": params},
+                window,
+                pad_count,
+                cross_k,
+                cross_v,
+                length,
+                method=_decode_step_boundary,
+            )
+            return (window, pad_count, finished, logits, cross_k, cross_v, length), token
+
+        carry = (window, pad_count, finished, logits, cross_k, cross_v, length)
+        carry, tokens = jax.lax.scan(boundary_step, carry, step_rngs[s1:s2])
+        window, pad_count, finished = carry[0], carry[1], carry[2]
+        m0 = m_full
+        token_blocks.append(tokens)
+
+    if config.max_new_tokens > s2:
 
         def step(carry, step_rng):
             window, pad_count, m, finished = carry
@@ -343,7 +516,7 @@ def generate(
             return (window, pad_count, m, finished), token
 
         carry = (window, pad_count, m0, finished)
-        _, tokens = jax.lax.scan(step, carry, step_rngs[cached_steps:])
+        _, tokens = jax.lax.scan(step, carry, step_rngs[s2:])
         token_blocks.append(tokens)
 
     return jnp.concatenate(token_blocks, axis=0).T.astype(input_ids.dtype)

@@ -26,6 +26,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from perceiver_io_tpu.models.core.adapter import TrainableQueryProvider
 from perceiver_io_tpu.ops.attention import dot_product_attention
@@ -49,6 +50,24 @@ def _dense(features: int, use_bias: bool, init_scale: float, dtype, name: str) -
 def _layer_norm(dtype, name: str) -> nn.LayerNorm:
     # use_fast_variance=False: two-pass variance matches torch numerically
     return nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=dtype, name=name, use_fast_variance=False)
+
+
+def _remat_policy(offload: bool):
+    """Remat saving policy for activation checkpointing. ``offload=False``
+    saves nothing (pure rematerialization). ``offload=True`` is the TPU-native
+    equivalent of the reference's ``checkpoint_wrapper(offload_to_cpu=True)``
+    (reference ``modules.py:347-348``): the layer-boundary inputs (tagged
+    ``remat_layer_input`` via ``checkpoint_name``) are saved but moved to
+    pinned host memory, everything else is rematerialized — HBM holds no
+    per-layer activations between forward and backward."""
+    if not offload:
+        return None
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["remat_layer_input"],
+        offload_src="device",
+        offload_dst="pinned_host",
+    )
 
 
 class MultiHeadAttention(nn.Module):
@@ -367,6 +386,7 @@ class CrossAttentionLayer(nn.Module):
         rot_pos_emb_k: Optional[RotaryEmbedding] = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
+        x_q = checkpoint_name(x_q, "remat_layer_input")
         attn_out = self.cross_attn(
             x_q,
             x_kv=x_kv,
@@ -436,6 +456,7 @@ class SelfAttentionLayer(nn.Module):
         rot_pos_emb: Optional[RotaryEmbedding] = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
+        x = checkpoint_name(x, "remat_layer_input")
         attn_out = self.self_attn(x, pad_mask=pad_mask, rot_pos_emb=rot_pos_emb, deterministic=deterministic)
         x = self.attn_residual(attn_out, x, deterministic=deterministic)
         return self.mlp_residual(self.mlp(x), x, deterministic=deterministic)
@@ -465,6 +486,7 @@ class SelfAttentionBlock(nn.Module):
     dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     rotary_all_layers: bool = False
     qkv_bias: bool = True
     out_bias: bool = True
@@ -477,7 +499,11 @@ class SelfAttentionBlock(nn.Module):
         layer_cls = SelfAttentionLayer
         if self.activation_checkpointing:
             # argnums include the module as 0: (x=1, pad_mask=2, rot_pos_emb=3, deterministic=4)
-            layer_cls = nn.remat(SelfAttentionLayer, static_argnums=(4,))
+            layer_cls = nn.remat(
+                SelfAttentionLayer,
+                static_argnums=(4,),
+                policy=_remat_policy(self.activation_offloading),
+            )
         self.layers = [
             layer_cls(
                 num_heads=self.num_heads,
@@ -545,6 +571,7 @@ class PerceiverEncoder(nn.Module):
     residual_dropout: float = 0.0
     init_scale: float = 0.02
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     dtype: Any = jnp.float32
     attention_impl: str = "auto"
 
@@ -577,7 +604,11 @@ class PerceiverEncoder(nn.Module):
             if self.activation_checkpointing:
                 # argnums include the module as 0: (x_q=1, x_kv=2, x_kv_prefix=3, pad_mask=4,
                 # rot_q=5, rot_k=6, deterministic=7)
-                cls = nn.remat(CrossAttentionLayer, static_argnums=(7,))
+                cls = nn.remat(
+                    CrossAttentionLayer,
+                    static_argnums=(7,),
+                    policy=_remat_policy(self.activation_offloading),
+                )
             return cls(
                 num_heads=self.num_cross_attention_heads,
                 num_q_input_channels=self.num_latent_channels,
@@ -604,6 +635,7 @@ class PerceiverEncoder(nn.Module):
                 dropout=self.dropout,
                 residual_dropout=self.residual_dropout,
                 activation_checkpointing=self.activation_checkpointing,
+                activation_offloading=self.activation_offloading,
                 init_scale=self.init_scale,
                 dtype=self.dtype,
                 attention_impl=self.attention_impl,
@@ -669,13 +701,18 @@ class PerceiverDecoder(nn.Module):
     dropout: float = 0.0
     init_scale: float = 0.02
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     dtype: Any = jnp.float32
     attention_impl: str = "auto"
 
     def setup(self):
         cls = CrossAttentionLayer
         if self.activation_checkpointing:
-            cls = nn.remat(CrossAttentionLayer, static_argnums=(7,))
+            cls = nn.remat(
+                CrossAttentionLayer,
+                static_argnums=(7,),
+                policy=_remat_policy(self.activation_offloading),
+            )
         self.cross_attn = cls(
             num_heads=self.num_cross_attention_heads,
             num_q_input_channels=self.num_output_query_channels,
@@ -749,6 +786,7 @@ class PerceiverAR(nn.Module):
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     init_scale: float = 0.02
     dtype: Any = jnp.float32
     attention_impl: str = "auto"
@@ -757,7 +795,11 @@ class PerceiverAR(nn.Module):
         num_channels = self.input_adapter.num_input_channels
         cls = CrossAttentionLayer
         if self.activation_checkpointing:
-            cls = nn.remat(CrossAttentionLayer, static_argnums=(7,))
+            cls = nn.remat(
+                CrossAttentionLayer,
+                static_argnums=(7,),
+                policy=_remat_policy(self.activation_offloading),
+            )
         self.cross_attention = cls(
             num_heads=self.num_heads,
             num_q_input_channels=num_channels,
@@ -784,6 +826,7 @@ class PerceiverAR(nn.Module):
             dropout=self.post_attention_dropout,
             residual_dropout=self.residual_dropout,
             activation_checkpointing=self.activation_checkpointing,
+            activation_offloading=self.activation_offloading,
             qkv_bias=False,
             out_bias=False,
             mlp_bias=False,

@@ -151,7 +151,7 @@ class AutoregressiveSequenceModel(nn.Module):
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             name="perceiver_ar",
-            **cfg.base_kwargs(exclude=("activation_offloading",)),
+            **cfg.base_kwargs(),
         )
         if cfg.output_norm:
             self.out_norm = nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=self.dtype, name="out_norm", use_fast_variance=False)

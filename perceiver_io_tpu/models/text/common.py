@@ -83,6 +83,7 @@ def make_text_encoder(
     num_latents: int,
     num_latent_channels: int,
     activation_checkpointing: bool = False,
+    activation_offloading: bool = False,
     dtype: Any = jnp.float32,
     attention_impl: str = "auto",
     name: str = "encoder",
@@ -103,6 +104,7 @@ def make_text_encoder(
         num_latents=num_latents,
         num_latent_channels=num_latent_channels,
         activation_checkpointing=activation_checkpointing,
+        activation_offloading=activation_offloading,
         dtype=dtype,
         attention_impl=attention_impl,
         name=name,

@@ -87,6 +87,7 @@ class ImageClassifier(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             name="encoder",
@@ -108,6 +109,7 @@ class ImageClassifier(nn.Module):
             num_latent_channels=cfg.num_latent_channels,
             num_output_query_channels=cfg.decoder.num_output_query_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             name="decoder",

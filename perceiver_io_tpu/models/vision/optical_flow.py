@@ -134,6 +134,7 @@ class OpticalFlow(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             name="encoder",
@@ -151,6 +152,7 @@ class OpticalFlow(nn.Module):
             num_latent_channels=cfg.num_latent_channels,
             num_output_query_channels=input_adapter.num_input_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             name="decoder",

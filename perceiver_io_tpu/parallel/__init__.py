@@ -15,6 +15,12 @@ All collectives are emitted by XLA from :class:`~jax.sharding.PartitionSpec`
 annotations — there is no hand-written NCCL/MPI equivalent to port.
 """
 from perceiver_io_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
+from perceiver_io_tpu.parallel.multihost import (
+    global_batch,
+    initialize,
+    is_multihost,
+    shard_or_assemble,
+)
 from perceiver_io_tpu.parallel.partition import (
     batch_sharding,
     batch_spec,
@@ -35,6 +41,10 @@ from perceiver_io_tpu.parallel.train_step import (
 __all__ = [
     "MeshConfig",
     "make_mesh",
+    "initialize",
+    "is_multihost",
+    "global_batch",
+    "shard_or_assemble",
     "batch_sharding",
     "infer_param_specs",
     "param_shardings",

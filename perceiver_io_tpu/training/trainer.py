@@ -29,7 +29,7 @@ from perceiver_io_tpu.parallel import (
     create_train_state,
     make_eval_step,
     make_train_step,
-    shard_batch,
+    shard_or_assemble,
 )
 from perceiver_io_tpu.training.checkpoint import BestCheckpointManager
 
@@ -182,7 +182,7 @@ class Trainer:
                             "(one-shot generator?); pass a list or a loader"
                         ) from None
                 rng, step_rng = jax.random.split(rng)
-                batch = shard_batch(batch, self.mesh, shard_seq=cfg.shard_seq)
+                batch = shard_or_assemble(batch, self.mesh, shard_seq=cfg.shard_seq)
                 if cfg.profile_start is not None and step_idx == cfg.profile_start:
                     jax.profiler.start_trace(
                         os.path.join(cfg.default_root_dir, "profile")
@@ -262,7 +262,7 @@ class Trainer:
                     break
                 metrics = eval_step(
                     self.state,
-                    shard_batch(batch, self.mesh, shard_seq=self.config.shard_seq),
+                    shard_or_assemble(batch, self.mesh, shard_seq=self.config.shard_seq),
                 )
                 for k, v in metrics.items():
                     totals[k] = totals.get(k, 0.0) + float(v)

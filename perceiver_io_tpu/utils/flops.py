@@ -97,6 +97,50 @@ def flops_approx(num_params: int) -> int:
     return 6 * num_params
 
 
+@dataclass
+class ScalingLaw:
+    """Compute-optimal allocation ``N_opt = k_n·C^a``, ``D_opt = k_d·C^b``
+    (reference ``examples/scaling/clm/scaling/laws.py:7-35``)."""
+
+    a: float
+    b: float
+    k_n: float
+    k_d: float
+
+    def n_opt(self, flops: float) -> float:
+        return self.k_n * flops**self.a
+
+    def d_opt(self, flops: float) -> float:
+        return self.k_d * flops**self.b
+
+    def __str__(self) -> str:
+        return (
+            f"N_opt = {self.k_n:.4f} * C ** {self.a:.2f}\n"
+            f"D_opt = {self.k_d:.4f} * C ** {self.b:.2f}"
+        )
+
+
+def fit_power_law(xs, ys, m: float, k0: float = 0.5) -> float:
+    """Least-squares fit of ``y = k·x^m`` for fixed exponent ``m``: closed
+    form ``k = Σ(y·x^m) / Σ(x^2m)`` — no scipy dependency needed."""
+    import numpy as np
+
+    xs = np.asarray(xs, dtype=np.float64) ** m
+    ys = np.asarray(ys, dtype=np.float64)
+    return float((xs * ys).sum() / (xs * xs).sum())
+
+
+def fit_scaling_law(flops_arr, params_arr, tokens_arr, a: float, b: float) -> ScalingLaw:
+    """Fit compute-optimal coefficients from (C, N, D) triples of the runs on
+    the loss-vs-compute frontier (reference ``laws.py:25-28``)."""
+    return ScalingLaw(
+        a=a,
+        b=b,
+        k_n=fit_power_law(flops_arr, params_arr, m=a),
+        k_d=fit_power_law(flops_arr, tokens_arr, m=b),
+    )
+
+
 def num_training_tokens(num_steps: int, num_latents: int, batch_size: int) -> int:
     return batch_size * num_latents * num_steps
 

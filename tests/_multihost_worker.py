@@ -1,0 +1,62 @@
+"""Worker process for the 2-process CPU multihost test (see
+``test_multihost.py``). Argv: process_id num_processes coordinator_port."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The environment's sitecustomize force-registers the TPU plugin; CPU must be
+# re-forced via jax.config after import (env JAX_PLATFORMS gets clobbered).
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import numpy as np
+
+    from perceiver_io_tpu.parallel import (
+        MeshConfig,
+        global_batch,
+        initialize,
+        is_multihost,
+        make_mesh,
+        shard_or_assemble,
+    )
+
+    initialize(
+        coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.process_index() == pid
+    assert is_multihost()
+    n_local = len(jax.local_devices())
+    assert jax.device_count() == nproc * n_local
+
+    import jax.numpy as jnp
+
+    mesh = make_mesh(MeshConfig(data=-1))
+
+    # Each process contributes its own rows; the global array must see all.
+    local = np.arange(2 * 3, dtype=np.float32).reshape(2, 3) + 100.0 * pid
+    batch = global_batch({"x": local}, mesh)
+    assert batch["x"].shape == (2 * nproc, 3), batch["x"].shape
+
+    with mesh:
+        total = jax.jit(jnp.sum)(batch["x"])
+    expected = sum(
+        float((np.arange(6, dtype=np.float32) + 100.0 * p).sum()) for p in range(nproc)
+    )
+    assert float(total) == expected, (float(total), expected)
+
+    # The dispatcher must pick the multihost path.
+    batch2 = shard_or_assemble({"x": local}, mesh)
+    assert batch2["x"].shape == (2 * nproc, 3)
+
+    print(f"MULTIHOST_OK {pid} {float(total)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

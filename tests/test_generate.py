@@ -48,8 +48,8 @@ def models():
     return t_model, j_model, params
 
 
-def reference_generate_greedy(t_model, input_ids, num_latents, max_new_tokens):
-    """Drive the reference HF wrapper's generate loop (greedy)."""
+def reference_generate(t_model, input_ids, num_latents, max_new_tokens, **gen_kwargs):
+    """Drive the reference HF wrapper's generate loop (greedy by default)."""
     import importlib
 
     from transformers import GenerationMixin
@@ -74,8 +74,13 @@ def reference_generate_greedy(t_model, input_ids, num_latents, max_new_tokens):
         min_new_tokens=max_new_tokens,
         do_sample=False,
         pad_token_id=0,
+        **gen_kwargs,
     )
     return out[:, input_ids.shape[1] :].numpy()
+
+
+def reference_generate_greedy(t_model, input_ids, num_latents, max_new_tokens):
+    return reference_generate(t_model, input_ids, num_latents, max_new_tokens)
 
 
 class TestReferenceParity:
@@ -100,6 +105,64 @@ class TestReferenceParity:
             GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents),
         )
         np.testing.assert_array_equal(np.asarray(got), expected)
+
+
+class TestBeamParity:
+    """Beam decode must produce the exact tokens the torch reference produces
+    through HF ``generate(num_beams=3)`` (reference
+    ``tests/causal_language_model_pipeline_test.py:37-38``)."""
+
+    @pytest.mark.parametrize(
+        "prompt_len,num_latents,new_tokens,num_beams",
+        [
+            (4, 2, 4, 3),     # latent growth only
+            (4, 2, 14, 3),    # crosses prefix growth and slide
+            (12, 8, 10, 2),   # starts at max latents
+        ],
+    )
+    def test_beam_token_exact(self, models, prompt_len, num_latents, new_tokens, num_beams):
+        t_model, j_model, params = models
+        ids = np.random.default_rng(4).integers(1, KW["vocab_size"], (2, prompt_len))
+
+        expected = reference_generate(
+            t_model, ids, num_latents, new_tokens, num_beams=num_beams
+        )
+        got = generate(
+            j_model,
+            params,
+            jnp.asarray(ids),
+            GenerationConfig(
+                max_new_tokens=new_tokens,
+                num_latents=num_latents,
+                num_beams=num_beams,
+                min_new_tokens=new_tokens,
+            ),
+        )
+        np.testing.assert_array_equal(np.asarray(got), expected)
+
+    def test_beam_eos_pads_tail(self, models):
+        # Standalone EOS behavior: once a hypothesis finishes, its tail is pad.
+        _, j_model, params = models
+        ids = np.random.default_rng(8).integers(1, KW["vocab_size"], (2, 4))
+        out = np.asarray(
+            generate(
+                j_model,
+                params,
+                jnp.asarray(ids),
+                GenerationConfig(
+                    max_new_tokens=10,
+                    num_latents=2,
+                    num_beams=3,
+                    eos_token_id=5,
+                    pad_token_id=0,
+                ),
+            )
+        )
+        assert out.shape == (2, 10)
+        for row in out:
+            hits = np.where(row == 5)[0]
+            if hits.size:
+                assert (row[hits[0] + 1 :] == 0).all()
 
 
 class TestValidation:
@@ -186,9 +249,12 @@ class TestKVCacheEquivalence:
     @pytest.mark.parametrize(
         "prompt_len,num_latents,new_tokens",
         [
-            (4, 2, 4),    # fully inside the cached phase
-            (4, 2, 20),   # cached phase then recompute tail
-            (12, 8, 12),  # cache ineligible from the start (m == max_latents)
+            (4, 2, 4),    # stays in latent growth
+            (4, 2, 8),    # crosses latent growth -> prefix growth
+            (4, 2, 20),   # crosses all three phases (growth -> prefix -> slide)
+            (12, 8, 12),  # starts in prefix growth (m == max_latents), crosses slide
+            (16, 8, 6),   # full window from the start (slide only)
+            (5, 5, 14),   # no initial prefix, all-latent prompt
         ],
     )
     def test_cache_matches_recompute(self, models, prompt_len, num_latents, new_tokens):
@@ -213,5 +279,36 @@ class TestKVCacheEquivalence:
         cached = generate(j_model, params, ids, cfg, rng=rng, prompt_pad_count=pad)
         recomputed = generate(
             j_model, params, ids, cfg, rng=rng, prompt_pad_count=pad, use_cache=False
+        )
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomputed))
+
+    def test_cache_ragged_crossing_prefix_growth(self, models):
+        # pads (2) fit within the nominal prefix (8 - 3 = 5), so the
+        # boundary-phase cache stays eligible; run crosses all three phases.
+        _, j_model, params = models
+        ids = jnp.asarray(
+            np.random.default_rng(5).integers(1, KW["vocab_size"], (2, 8)), jnp.int32
+        )
+        pad = jnp.asarray([2, 0], jnp.int32)
+        cfg = GenerationConfig(max_new_tokens=14, num_latents=3)
+        cached = generate(j_model, params, ids, cfg, prompt_pad_count=pad)
+        recomputed = generate(
+            j_model, params, ids, cfg, prompt_pad_count=pad, use_cache=False
+        )
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomputed))
+
+    def test_cache_falls_back_when_pads_exceed_prefix(self, models):
+        # A row with more pads than the nominal prefix would put pad tokens in
+        # latent slots during prefix growth; the cache must detect this and
+        # fall back to exact windowed recompute for those steps.
+        _, j_model, params = models
+        ids = jnp.asarray(
+            np.random.default_rng(6).integers(1, KW["vocab_size"], (2, 8)), jnp.int32
+        )
+        pad = jnp.asarray([4, 0], jnp.int32)  # 4 > prefix_len 8 - 6 = 2
+        cfg = GenerationConfig(max_new_tokens=12, num_latents=6)
+        cached = generate(j_model, params, ids, cfg, prompt_pad_count=pad)
+        recomputed = generate(
+            j_model, params, ids, cfg, prompt_pad_count=pad, use_cache=False
         )
         np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomputed))

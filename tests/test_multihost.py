@@ -1,0 +1,69 @@
+"""Multi-host runtime test: a real 2-process ``jax.distributed`` CPU cluster
+(the simulation strategy for pods SURVEY.md §2.5 calls for — the reference
+has no multi-process test at all; its rank sharding is only exercised on
+live clusters).
+
+Each worker gets 2 virtual CPU devices (4 global), initializes the
+distributed runtime against a local coordinator, assembles a global batch
+from process-local rows via ``jax.make_array_from_process_local_data``, and
+reduces it under ``jit`` — the reduction crosses process boundaries, proving
+the collectives path, not just the API surface.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_cpu_cluster():
+    port = _free_port()
+    nproc = 2
+    env = dict(os.environ)
+    # Fresh, per-process XLA flags: 2 virtual CPU devices per process.
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=2"])
+
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "tests", "_multihost_worker.py"),
+                str(pid),
+                str(nproc),
+                str(port),
+            ],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"MULTIHOST_OK {pid}" in out, out
