@@ -147,9 +147,22 @@ class SymbolicAudioDataModule:
     def preproc_dir(self) -> Path:
         return self.dataset_dir / "preproc"
 
-    def load_source_dataset(self) -> Dict[str, Path]:
-        """Return ``{"train": dir, "valid": dir}`` of MIDI directories."""
+    def load_source_dataset(self) -> Dict[str, object]:
+        """Return ``{"train": ..., "valid": ...}`` MIDI sources.
+
+        Each value is either a directory (``rglob``-ed for ``.mid``/``.midi``)
+        or an explicit list of files (manifest- or bucket-derived splits).
+        Train and valid must be disjoint — overlapping splits leak training
+        data into validation and make val_loss meaningless.
+        """
         raise NotImplementedError
+
+    @staticmethod
+    def _midi_files(source) -> List[Path]:
+        if isinstance(source, (list, tuple)):
+            return sorted(Path(f) for f in source)
+        midi_dir = Path(source)
+        return sorted(midi_dir.rglob("**/*.mid")) + sorted(midi_dir.rglob("**/*.midi"))
 
     @classmethod
     def from_token_streams(
@@ -173,13 +186,29 @@ class SymbolicAudioDataModule:
         return np.concatenate(parts)
 
     def prepare_data(self) -> None:
-        if self._splits or self.preproc_dir.exists():
+        if self._splits:
+            return
+        if self.preproc_dir.exists():
+            # Caches written before disjoint splits existed have no manifest
+            # and were built with train == valid — refuse to reuse them.
+            if not (self.preproc_dir / "split_manifest.json").exists():
+                raise ValueError(
+                    f"{self.preproc_dir} was built by an older version with "
+                    "overlapping train/valid splits (no split_manifest.json); "
+                    "delete it and re-run preprocessing"
+                )
             return
         sources = self.load_source_dataset()
+        split_files = {s: self._midi_files(sources[s]) for s in ("train", "valid")}
+        overlap = set(map(str, split_files["train"])) & set(map(str, split_files["valid"]))
+        if overlap:
+            raise ValueError(
+                f"train/valid splits overlap on {len(overlap)} files "
+                f"(e.g. {sorted(overlap)[0]}) — validation would leak training data"
+            )
         os.makedirs(self.preproc_dir)
         for split in ("train", "valid"):
-            midi_dir = Path(sources[split])
-            files = sorted(midi_dir.rglob("**/*.mid")) + sorted(midi_dir.rglob("**/*.midi"))
+            files = split_files[split]
             pieces = encode_midi_files(files, num_workers=self.preproc_workers)
             flat = self.flatten_pieces(
                 pieces, shuffle_seed=self.seed if split == "train" else None
@@ -189,6 +218,11 @@ class SymbolicAudioDataModule:
             )
             fp[:] = flat
             fp.flush()
+        import json
+
+        (self.preproc_dir / "split_manifest.json").write_text(
+            json.dumps({s: [str(f) for f in split_files[s]] for s in ("train", "valid")})
+        )
 
     def setup(self) -> None:
         if self._splits:
@@ -226,28 +260,73 @@ class SymbolicAudioDataModule:
 
 
 class MaestroV3DataModule(SymbolicAudioDataModule):
-    """MAESTRO v3 piano corpus (reference ``maestro_v3.py``): expects the
-    extracted archive at ``<dataset_dir>/maestro-v3.0.0`` (zero-egress images
-    cannot download; point ``dataset_dir`` at a local copy)."""
+    """MAESTRO v3 piano corpus: expects the extracted archive at
+    ``<dataset_dir>/maestro-v3.0.0`` (zero-egress images cannot download;
+    point ``dataset_dir`` at a local copy).
 
-    def load_source_dataset(self) -> Dict[str, Path]:
+    Splits follow the official ``maestro-v3.0.0.json`` manifest exactly as
+    the reference does (``maestro_v3.py:58-76``): columnar
+    ``metadata["midi_filename"]``/``metadata["split"]``, ``train`` →
+    train, ``validation`` → valid, ``test`` excluded.
+    """
+
+    def load_source_dataset(self) -> Dict[str, List[Path]]:
+        import json
+
         root = self.dataset_dir / "maestro-v3.0.0"
         if not root.exists():
             raise FileNotFoundError(
                 f"{root} not found — place the extracted MAESTRO v3 archive there"
             )
-        return {"train": root, "valid": root}
+        meta_file = root / "maestro-v3.0.0.json"
+        if not meta_file.exists():
+            raise FileNotFoundError(f"missing MAESTRO manifest {meta_file}")
+        with open(meta_file) as f:
+            metadata = json.load(f)
+        splits: Dict[str, List[Path]] = {"train": [], "valid": []}
+        for _id, file_path in metadata["midi_filename"].items():
+            split = metadata["split"][_id]
+            if split == "test":
+                continue
+            splits["train" if split == "train" else "valid"].append(root / file_path)
+        return splits
 
 
 class GiantMidiPianoDataModule(SymbolicAudioDataModule):
-    """GiantMIDI-Piano corpus (reference ``giantmidi_piano.py``): expects
-    ``<dataset_dir>/midis`` with a train/valid split by trailing filename
-    digit (valid = hash bucket 0)."""
+    """GiantMIDI-Piano corpus: expects MIDI files under ``<dataset_dir>/midis``.
+
+    The reference's hosted archive ships pre-split ``train``/``valid``
+    directories (``giantmidi_piano.py:38-47``); when those exist they are
+    used as-is. A flat ``midis`` directory (the upstream GiantMIDI layout)
+    is split deterministically by filename hash instead: ``valid`` = files
+    whose ``crc32(name) % num_buckets == valid_bucket`` — stable across runs
+    and machines, and disjoint from train by construction.
+    """
 
     valid_bucket: int = 0
+    num_buckets: int = 10
 
-    def load_source_dataset(self) -> Dict[str, Path]:
+    def load_source_dataset(self) -> Dict[str, object]:
         root = self.dataset_dir / "midis"
         if not root.exists():
             raise FileNotFoundError(f"{root} not found — place GiantMIDI midis there")
-        return {"train": root, "valid": root}
+        train_dir, valid_dir = root / "train", root / "valid"
+        if train_dir.exists() and valid_dir.exists():
+            return {"train": train_dir, "valid": valid_dir}
+        if train_dir.exists() or valid_dir.exists():
+            raise ValueError(
+                f"{root} has only one of train/valid — a partially extracted "
+                "pre-split archive; hash-splitting it would discard the "
+                "curated split. Restore both directories or remove the one."
+            )
+        import zlib
+
+        files = self._midi_files(root)
+        in_valid = [
+            zlib.crc32(f.name.encode()) % self.num_buckets == self.valid_bucket
+            for f in files
+        ]
+        return {
+            "train": [f for f, v in zip(files, in_valid) if not v],
+            "valid": [f for f, v in zip(files, in_valid) if v],
+        }

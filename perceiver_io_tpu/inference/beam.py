@@ -11,9 +11,11 @@ jittable ``lax.scan`` over the same right-aligned static window as
 - per step: ``log_softmax`` over next-token logits, cumulative scores,
   top-``2k`` candidates over the flattened ``(k·V)`` score matrix;
 - candidates ending in EOS are moved into a per-batch hypothesis buffer
-  (score length-normalized at insertion, ``score / len**length_penalty``
-  with ``len`` counting prompt + generated, as HF's ``BeamHypotheses.add``);
-  the first ``k`` non-EOS candidates continue as live beams;
+  (score length-normalized at insertion, ``score / gen_len**length_penalty``
+  with ``gen_len`` counting *generated* tokens only, matching the vectorized
+  ``_beam_search`` in transformers >= 4.50 — older HF ``BeamHypotheses.add``
+  normalized by prompt + generated); the first ``k`` non-EOS candidates
+  continue as live beams;
 - termination is by ``max_new_tokens`` (``early_stopping=False`` semantics:
   the search runs to max length, then live beams are finalized against the
   hypothesis buffer).
@@ -117,7 +119,7 @@ def beam_search(
             in_first_k = jnp.arange(2 * k)[None, :] < k
             hyp_cand_score = jnp.where(
                 is_eos & in_first_k,
-                cand_scores / ((prompt_len + t + 1.0) ** length_penalty),
+                cand_scores / ((t + 1.0) ** length_penalty),
                 -jnp.inf,
             )
             for _ in range(k):
@@ -183,8 +185,8 @@ def beam_search(
     _, _, _, beam_scores, tok_buf, hyp_scores, hyp_tokens = carry
 
     # Finalize (HF with early_stopping=False at max length): live beams join
-    # the hypothesis pool, length-normalized at full length.
-    live_final = beam_scores / ((prompt_len + t_max) ** length_penalty)
+    # the hypothesis pool, length-normalized at generated length.
+    live_final = beam_scores / (float(t_max) ** length_penalty)
     all_scores = jnp.concatenate([hyp_scores, live_final], axis=1)  # (b, 2k)
     all_tokens = jnp.concatenate([hyp_tokens, tok_buf], axis=1)  # (b, 2k, t_max)
     best = jnp.argmax(all_scores, axis=1)

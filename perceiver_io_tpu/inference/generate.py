@@ -75,7 +75,8 @@ class GenerationConfig:
     #: beam width; > 1 dispatches :func:`generate` to beam search (greedy
     #: candidate expansion, HF ``GenerationMixin`` semantics).
     num_beams: int = 1
-    #: HF exponent on hypothesis length (prompt + generated) when ranking.
+    #: HF exponent on generated length when ranking hypotheses (matches the
+    #: vectorized ``_beam_search`` in transformers >= 4.50).
     length_penalty: float = 1.0
     #: EOS is masked to -inf until this many new tokens exist (beam search).
     min_new_tokens: int = 0

@@ -36,6 +36,20 @@ class _Pipeline:
         return self.model.apply({"params": params}, *args, **kwargs)
 
 
+def _warn_sampling_ignored_under_beam(num_beams, temperature, top_k, top_p):
+    """Beam dispatch is deterministic; sampling knobs would be silently
+    dropped (HF warns the same way for ``temperature`` + ``do_sample=False``)."""
+    if num_beams > 1 and (temperature != 1.0 or top_k is not None or top_p is not None):
+        import warnings
+
+        warnings.warn(
+            "temperature/top_k/top_p are ignored when num_beams > 1 — beam "
+            "search decodes deterministically",
+            UserWarning,
+            stacklevel=3,
+        )
+
+
 def _pad_batch(rows: List[np.ndarray], pad_id: int, side: str) -> Tuple[np.ndarray, np.ndarray]:
     width = max(len(r) for r in rows)
     out = np.full((len(rows), width), pad_id, np.int32)
@@ -65,9 +79,12 @@ class TextGenerationPipeline(_Pipeline):
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        num_beams: int = 1,
+        length_penalty: float = 1.0,
         seed: int = 0,
         return_full_text: bool = True,
     ) -> List[str]:
+        _warn_sampling_ignored_under_beam(num_beams, temperature, top_k, top_p)
         single = isinstance(prompts, str)
         batch = [prompts] if single else list(prompts)
         encoded = [np.asarray(self.tokenizer.encode(p), np.int32) for p in batch]
@@ -80,6 +97,8 @@ class TextGenerationPipeline(_Pipeline):
             num_latents=num_latents,
             pad_token_id=pad_id,
             eos_token_id=self.tokenizer.eos_token_id,
+            num_beams=num_beams,
+            length_penalty=length_penalty,
             sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p),
         )
         out = generate(
@@ -224,10 +243,13 @@ class SymbolicAudioPipeline(_Pipeline):
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        num_beams: int = 1,
+        length_penalty: float = 1.0,
         seed: int = 0,
     ) -> List[np.ndarray]:
         from perceiver_io_tpu.data.audio import PAD_TOKEN
 
+        _warn_sampling_ignored_under_beam(num_beams, temperature, top_k, top_p)
         if isinstance(prompts, np.ndarray) and prompts.ndim == 1:
             batch = [np.asarray(prompts, np.int32)]
         elif isinstance(prompts, (list, tuple)) and prompts and np.isscalar(prompts[0]):
@@ -241,6 +263,8 @@ class SymbolicAudioPipeline(_Pipeline):
             max_new_tokens=max_new_tokens,
             num_latents=num_latents,
             pad_token_id=PAD_TOKEN,
+            num_beams=num_beams,
+            length_penalty=length_penalty,
             sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p),
         )
         out = generate(

@@ -158,3 +158,79 @@ def test_datamodule_from_streams_batches():
     assert batch["labels"].shape == (4, 32)
     assert batch["pad_mask"].dtype == bool
     assert batch["input_ids"].max() < VOCAB_SIZE
+
+
+# -- train/valid split semantics ------------------------------------------
+def test_maestro_manifest_split(tmp_path):
+    """Official-manifest split (reference maestro_v3.py:58-76): train ->
+    train, validation -> valid, test excluded; splits disjoint."""
+    import json
+
+    from perceiver_io_tpu.data.audio.symbolic import MaestroV3DataModule
+
+    root = tmp_path / "maestro-v3.0.0"
+    names = [f"2018/piece_{i}.midi" for i in range(6)]
+    splits = ["train", "validation", "test", "train", "validation", "train"]
+    for name in names:
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.touch()
+    manifest = {
+        "midi_filename": {str(i): n for i, n in enumerate(names)},
+        "split": {str(i): s for i, s in enumerate(splits)},
+    }
+    (root / "maestro-v3.0.0.json").write_text(json.dumps(manifest))
+
+    dm = MaestroV3DataModule(str(tmp_path), max_seq_len=32)
+    sources = dm.load_source_dataset()
+    train = {p.name for p in sources["train"]}
+    valid = {p.name for p in sources["valid"]}
+    assert train == {"piece_0.midi", "piece_3.midi", "piece_5.midi"}
+    assert valid == {"piece_1.midi", "piece_4.midi"}
+    assert not train & valid  # disjoint; test pieces in neither
+
+
+def test_giantmidi_presplit_dirs(tmp_path):
+    from perceiver_io_tpu.data.audio.symbolic import GiantMidiPianoDataModule
+
+    for split in ("train", "valid"):
+        d = tmp_path / "midis" / split
+        d.mkdir(parents=True)
+        (d / f"{split}_piece.mid").touch()
+    dm = GiantMidiPianoDataModule(str(tmp_path), max_seq_len=32)
+    sources = dm.load_source_dataset()
+    assert sources["train"] == tmp_path / "midis" / "train"
+    assert sources["valid"] == tmp_path / "midis" / "valid"
+
+
+def test_giantmidi_bucket_split_disjoint_and_stable(tmp_path):
+    import zlib
+
+    from perceiver_io_tpu.data.audio.symbolic import GiantMidiPianoDataModule
+
+    root = tmp_path / "midis"
+    root.mkdir()
+    names = [f"piece_{i:03d}.mid" for i in range(40)]
+    for n in names:
+        (root / n).touch()
+    dm = GiantMidiPianoDataModule(str(tmp_path), max_seq_len=32)
+    sources = dm.load_source_dataset()
+    train = {p.name for p in sources["train"]}
+    valid = {p.name for p in sources["valid"]}
+    assert not train & valid
+    assert train | valid == set(names)
+    assert valid  # bucket 0 of 10 over 40 names is non-empty
+    for n in valid:
+        assert zlib.crc32(n.encode()) % dm.num_buckets == dm.valid_bucket
+
+
+def test_prepare_data_rejects_overlapping_splits(tmp_path):
+    (tmp_path / "a.mid").touch()
+
+    class Leaky(SymbolicAudioDataModule):
+        def load_source_dataset(self):
+            return {"train": tmp_path, "valid": tmp_path}
+
+    dm = Leaky(str(tmp_path / "ds"), max_seq_len=32)
+    with pytest.raises(ValueError, match="overlap"):
+        dm.prepare_data()

@@ -181,6 +181,36 @@ def test_symbolic_audio_pipeline():
     assert (np.asarray(outs[0]) < cfg.vocab_size).all()
 
 
+def test_symbolic_audio_pipeline_beam():
+    # reference tests/symbolic_audio_model_pipeline_test.py:95-96 drives
+    # num_beams=3 through the audio pipeline surface.
+    from perceiver_io_tpu.models.audio.symbolic import (
+        SymbolicAudioModel,
+        SymbolicAudioModelConfig,
+    )
+
+    cfg = SymbolicAudioModelConfig(
+        max_seq_len=32, max_latents=16, num_channels=32,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = SymbolicAudioModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 16)["params"]
+
+    pipe = pipeline("symbolic-audio-generation", model, params)
+    prompt = np.array([60, 256 + 49, 128 + 60], np.int32)
+    outs = pipe([prompt], max_new_tokens=5, num_latents=4, num_beams=3)
+    assert len(outs) == 1 and len(outs[0]) == len(prompt) + 5
+    assert (np.asarray(outs[0]) < cfg.vocab_size).all()
+
+
+def test_text_generation_pipeline_beam(tiny_clm):
+    # reference tests/causal_language_model_pipeline_test.py:37-38.
+    model, params = tiny_clm
+    pipe = pipeline("text-generation", model, params, ByteTokenizer(padding_side="left"))
+    outs = pipe(["hello", "hi"], max_new_tokens=4, num_latents=4, num_beams=3)
+    assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+
+
 def test_unknown_task_rejected(tiny_clm):
     model, params = tiny_clm
     with pytest.raises(ValueError, match="unknown task"):
