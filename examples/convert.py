@@ -1,42 +1,127 @@
-"""Checkpoint conversion CLI — parity with the reference's ``examples/convert.py``:
-import torch checkpoints (reference Lightning ``.ckpt`` state dicts or HF
-``pytorch_model.bin``/safetensors state dicts) into a TPU-native
-``save_pretrained`` dir.
+"""Checkpoint conversion CLI — parity with the reference's
+``examples/convert.py`` (which drives 3 official HF models + 5 hosted
+training checkpoints through one entrypoint). This environment is
+zero-egress, so sources are local files instead of hub downloads:
 
-    python examples/convert.py clm path/to/state_dict.pt out_dir \
-        --vocab-size 262 --max-seq-len 4096 --max-latents 512
+Official DeepMind HF models (``pytorch_model.bin`` + ``config.json`` from
+the hub):
 
-The state-dict key mapping lives in ``perceiver_io_tpu/convert/torch_import.py``
-(one import_* function per task family, each parity-tested against the
-reference models in ``tests/test_torch_parity.py``).
+    python examples/convert.py mlm pytorch_model.bin out_dir --hf-config config.json
+    python examples/convert.py img-clf pytorch_model.bin out_dir --hf-config config.json
+    python examples/convert.py flow pytorch_model.bin out_dir --hf-config config.json
+
+Reference training checkpoints (Lightning ``.ckpt`` or bare state dicts,
+reference-backend layout):
+
+    python examples/convert.py clm epoch=000-val_loss=2.820.ckpt out_dir \
+        --vocab-size 32000 --max-seq-len 1024 --max-latents 512 --num-channels 896
+    python examples/convert.py sam epoch=027-val_loss=1.944.ckpt out_dir \
+        --max-seq-len 6144 --max-latents 2048 --num-channels 768
+    python examples/convert.py mlm mlm.ckpt out_dir            # 201M default shape
+    python examples/convert.py txt-clf txt_clf.ckpt out_dir --num-classes 2
+
+Key mappings live in ``perceiver_io_tpu/convert/`` (``torch_import`` for the
+reference layout, ``hf_import`` for transformers state dicts), each
+parity-tested in ``tests/test_torch_parity.py`` / ``tests/test_hf_convert.py``.
 """
 from __future__ import annotations
 
 import argparse
+import json
+
+
+def _load_state_dict(path: str):
+    import torch
+
+    if path.endswith(".safetensors"):
+        from safetensors.torch import load_file
+
+        return load_file(path)
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if "state_dict" in sd:  # Lightning checkpoint wrapper
+        sd = sd["state_dict"]
+    return sd
+
+
+def _d(value, fallback):
+    return fallback if value is None else value
+
+
+def _mlm_config(args):
+    """Reference-layout MLM config; unset flags fall back to the 201M model
+    the reference trains/fine-tunes (docs/training-examples.md:90-118):
+    d_model 768, 26 layers, ctx 2048, 256x1280 latents."""
+    from perceiver_io_tpu.models.core.config import PerceiverIOConfig
+    from perceiver_io_tpu.models.text.common import TextEncoderConfig
+    from perceiver_io_tpu.models.text.mlm import TextDecoderConfig
+
+    vocab = _d(args.vocab_size, 262)
+    seq = _d(args.max_seq_len, 2048)
+    encoder = TextEncoderConfig(
+        vocab_size=vocab,
+        max_seq_len=seq,
+        num_input_channels=_d(args.num_channels, 768),
+        num_cross_attention_heads=8,
+        num_self_attention_heads=8,
+        num_self_attention_layers_per_block=_d(args.num_layers, 26),
+        num_self_attention_blocks=1,
+    )
+    decoder = TextDecoderConfig(vocab_size=vocab, max_seq_len=seq)
+    return PerceiverIOConfig(
+        encoder, decoder, num_latents=_d(args.num_latents, 256),
+        num_latent_channels=_d(args.num_latent_channels, 1280),
+    )
 
 
 def main() -> None:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("task", choices=["clm", "mlm", "sam"])
-    parser.add_argument("state_dict", help="torch .pt/.ckpt file")
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("task", choices=["clm", "sam", "mlm", "img-clf", "flow", "txt-clf"])
+    parser.add_argument("state_dict", help="torch .pt/.ckpt/.bin/.safetensors file")
     parser.add_argument("out_dir")
-    parser.add_argument("--vocab-size", type=int, default=262)
-    parser.add_argument("--max-seq-len", type=int, default=4096)
-    parser.add_argument("--max-latents", type=int, default=512)
-    parser.add_argument("--num-channels", type=int, default=512)
-    parser.add_argument("--num-layers", type=int, default=8)
+    parser.add_argument(
+        "--hf-config",
+        help="transformers config.json — switches mlm/img-clf/flow to the "
+        "official-HF-model key layout (deepmind/* checkpoints)",
+    )
+    # shape flags default per task: clm/sam fall back to the reference AR
+    # shape (4096 ctx, 512 latents/channels, 8 layers); mlm/txt-clf to the
+    # 201M language-perceiver shape (2048 ctx, 768 ch, 26 layers, 256x1280)
+    parser.add_argument("--vocab-size", type=int, default=None)
+    parser.add_argument("--max-seq-len", type=int, default=None)
+    parser.add_argument("--max-latents", type=int, default=None)
+    parser.add_argument("--num-channels", type=int, default=None)
+    parser.add_argument("--num-layers", type=int, default=None)
+    parser.add_argument("--num-latents", type=int, default=None)
+    parser.add_argument("--num-latent-channels", type=int, default=None)
+    parser.add_argument("--num-classes", type=int, default=2)
     args = parser.parse_args()
-
-    import torch
 
     import perceiver_io_tpu.convert as convert
     from perceiver_io_tpu.training.checkpoint import save_pretrained
 
-    sd = torch.load(args.state_dict, map_location="cpu", weights_only=True)
-    if "state_dict" in sd:  # Lightning checkpoint wrapper
-        sd = sd["state_dict"]
+    sd = _load_state_dict(args.state_dict)
 
-    if args.task in ("clm", "sam"):
+    if args.hf_config:
+        import transformers
+
+        with open(args.hf_config) as f:
+            hf_cfg = transformers.PerceiverConfig(**json.load(f))
+        from perceiver_io_tpu.convert import hf_import
+
+        if args.task == "mlm":
+            cfg = hf_import.mlm_config_from_hf(hf_cfg)
+            params = hf_import.import_hf_masked_language_model(sd, cfg)
+        elif args.task == "img-clf":
+            cfg = hf_import.image_classifier_config_from_hf(hf_cfg)
+            params = hf_import.import_hf_image_classifier(sd, cfg)
+        elif args.task == "flow":
+            cfg = hf_import.optical_flow_config_from_hf(hf_cfg)
+            params = hf_import.import_hf_optical_flow(sd, cfg)
+        else:
+            raise SystemExit(f"--hf-config applies to mlm/img-clf/flow, not {args.task}")
+    elif args.task in ("clm", "sam"):
         if args.task == "clm":
             from perceiver_io_tpu.models.text.clm import CausalLanguageModelConfig as Cfg
 
@@ -46,15 +131,32 @@ def main() -> None:
 
             importer = convert.import_symbolic_audio_model
         cfg = Cfg(
-            vocab_size=args.vocab_size,
-            max_seq_len=args.max_seq_len,
-            max_latents=args.max_latents,
-            num_channels=args.num_channels,
-            num_self_attention_layers=args.num_layers,
+            vocab_size=_d(args.vocab_size, 262),
+            max_seq_len=_d(args.max_seq_len, 4096),
+            max_latents=_d(args.max_latents, 512),
+            num_channels=_d(args.num_channels, 512),
+            num_self_attention_layers=_d(args.num_layers, 8),
         )
         params = importer(sd, cfg)
+    elif args.task == "mlm":
+        cfg = _mlm_config(args)
+        params = convert.import_masked_language_model(sd, cfg)
+    elif args.task == "txt-clf":
+        from perceiver_io_tpu.models.core.config import (
+            ClassificationDecoderConfig,
+            PerceiverIOConfig,
+        )
+
+        mlm_cfg = _mlm_config(args)
+        cfg = PerceiverIOConfig(
+            mlm_cfg.encoder,
+            ClassificationDecoderConfig(num_classes=args.num_classes),
+            num_latents=mlm_cfg.num_latents,
+            num_latent_channels=mlm_cfg.num_latent_channels,
+        )
+        params = convert.import_text_classifier(sd, cfg)
     else:
-        raise SystemExit("mlm conversion needs encoder/decoder configs; use the API directly")
+        raise SystemExit(f"{args.task} requires --hf-config (official HF layout)")
 
     save_pretrained(args.out_dir, params, cfg)
     print(f"saved {args.task} model to {args.out_dir}")

@@ -197,3 +197,87 @@ def import_hf_image_classifier(hf_state_dict: Mapping[str, Any], config) -> Dict
     )
     ref_sd = _expand(m, hf_state_dict)
     return torch_import.import_image_classifier(ref_sd, config)
+
+
+# -- optical flow ----------------------------------------------------------
+def optical_flow_config_from_hf(config) -> Any:
+    """``transformers.PerceiverConfig`` → :data:`OpticalFlowConfig` (the
+    mapping the reference does in ``optical_flow/huggingface.py:177-203``,
+    corrected to what transformers actually builds: the flow preprocessor
+    hardcodes 64 post-patch channels + 64 Fourier bands
+    (``modeling_perceiver.py`` ``PerceiverForOpticalFlow.__init__``), and
+    ``PerceiverBasicDecoder`` defaults give the decoder ONE head with
+    qk = v = kv channels (``cross_attention_shape_for_attention="kv"`` →
+    the latent width) — not the config's qk/v settings."""
+    from perceiver_io_tpu.models.core.config import PerceiverIOConfig
+    from perceiver_io_tpu.models.vision.optical_flow import (
+        OpticalFlowDecoderConfig,
+        OpticalFlowEncoderConfig,
+    )
+
+    assert config.hidden_act == "gelu"
+    image_shape = tuple(config.train_size)
+    num_bands = 64
+    hidden = 64  # PerceiverImagePreprocessor out_channels default
+    query_channels = hidden + 2 * (2 * num_bands + 1)  # + concat fourier pos
+    assert config.d_model == query_channels, (
+        f"flow d_model must be {query_channels} (64 patch channels + fourier), "
+        f"got {config.d_model}"
+    )
+    encoder = OpticalFlowEncoderConfig(
+        image_shape=image_shape,
+        num_patch_input_channels=27,
+        num_patch_hidden_channels=hidden,
+        num_frequency_bands=num_bands,
+        num_cross_attention_qk_channels=config.qk_channels,
+        num_cross_attention_v_channels=config.v_channels,
+        num_cross_attention_heads=config.num_cross_attention_heads,
+        num_self_attention_qk_channels=config.qk_channels,
+        num_self_attention_v_channels=config.v_channels,
+        num_self_attention_heads=config.num_self_attention_heads,
+        num_self_attention_layers_per_block=config.num_self_attends_per_block,
+        num_self_attention_blocks=config.num_blocks,
+        cross_attention_widening_factor=config.cross_attention_widening_factor,
+        self_attention_widening_factor=config.self_attention_widening_factor,
+        dropout=config.attention_probs_dropout_prob,
+        init_scale=config.initializer_range,
+    )
+    decoder = OpticalFlowDecoderConfig(
+        image_shape=image_shape,
+        num_cross_attention_qk_channels=config.d_latents,
+        num_cross_attention_v_channels=config.d_latents,
+        num_cross_attention_heads=1,
+        cross_attention_widening_factor=config.cross_attention_widening_factor,
+        cross_attention_residual=False,
+        dropout=config.attention_probs_dropout_prob,
+        init_scale=config.initializer_range,
+        rescale_factor=100.0,
+    )
+    return PerceiverIOConfig(
+        encoder,
+        decoder,
+        num_latents=config.num_latents,
+        num_latent_channels=config.d_latents,
+    )
+
+
+def import_hf_optical_flow(hf_state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """``PerceiverForOpticalFlow`` state dict → flax params (module
+    correspondence per reference ``optical_flow/huggingface.py:177-203``:
+    ``conv_after_patches`` is the patch embedding, the decoder queries are
+    the adapted inputs so there is no trainable query)."""
+    m = _encoder_map(config.encoder.num_self_attention_layers_per_block)
+    m.update(
+        _layer_map(
+            "perceiver.decoder.decoder.decoding_cross_attention", "decoder.cross_attn",
+            residual=config.decoder.cross_attention_residual,
+        )
+    )
+    m.update(
+        {
+            "perceiver.input_preprocessor.conv_after_patches": "encoder.input_adapter.linear",
+            "perceiver.decoder.decoder.final_layer": "decoder.output_adapter.linear",
+        }
+    )
+    ref_sd = _expand(m, hf_state_dict)
+    return torch_import.import_optical_flow(ref_sd, config)

@@ -16,6 +16,12 @@ TPU-first design notes:
   (:mod:`perceiver_io_tpu.ops.flash_attention`) when shapes permit;
   ``impl='xla'`` is the reference-semantics einsum path. ``'auto'`` picks
   flash on TPU for long sequences.
+- ``impl='ring'`` dispatches to ring attention
+  (:mod:`perceiver_io_tpu.parallel.ring`): q and k/v sequence dims are
+  sharded over the ambient mesh's ``seq`` axis and k/v chunks rotate via
+  ``ppermute`` — context parallelism for sequences one device cannot hold.
+  Requires an active ``Mesh`` context with a ``seq`` axis (the trainer's
+  ``shard_seq`` path provides one).
 """
 from __future__ import annotations
 
@@ -58,6 +64,31 @@ def dot_product_attention(
     :param impl: ``'auto' | 'xla' | 'flash'``.
     :return: ``(b, h, i, cv)``.
     """
+    if impl == "ring":
+        if dropout_rate > 0.0:
+            raise ValueError("ring attention does not support attention dropout")
+        mesh = _ambient_mesh()
+        if mesh is None or "seq" not in mesh.axis_names or mesh.shape["seq"] == 1:
+            # No seq-sharded mesh in scope (e.g. model.init outside the mesh
+            # context): ring is numerically identical to the einsum path, so
+            # degrade gracefully instead of failing.
+            import warnings
+
+            warnings.warn(
+                "impl='ring' without an active Mesh with a 'seq' axis of "
+                "size > 1 — falling back to the XLA einsum path; wrap the "
+                "call in `with make_mesh(MeshConfig(seq=...)):` for "
+                "sequence-parallel execution",
+                UserWarning,
+                stacklevel=2,
+            )
+        else:
+            from perceiver_io_tpu.parallel.ring import ring_attention_sharded
+
+            return ring_attention_sharded(
+                q, k, v, mesh, axis_name="seq", pad_mask=pad_mask, causal=causal
+            )
+
     use_flash = False
     if impl == "flash" or (impl == "auto" and _flash_eligible(q, k, v, dropout_rate)):
         from perceiver_io_tpu.ops import flash_attention
@@ -90,6 +121,17 @@ def dot_product_attention(
             )
         )
     return jnp.concatenate(chunks, axis=1)
+
+
+def _ambient_mesh():
+    """The physical mesh of the enclosing ``with mesh:`` context, or None."""
+    try:
+        from jax.interpreters import pxla
+
+        mesh = pxla.thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception:
+        return None
 
 
 def _flash_eligible(q, k, v, dropout_rate) -> bool:

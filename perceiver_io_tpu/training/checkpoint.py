@@ -98,6 +98,58 @@ def load_subtree(path: str, subtree: str, *, target: Any = None):
     return node
 
 
+class ResumeCheckpointManager:
+    """Periodic full-``TrainState`` snapshots (step + params + optimizer
+    state, which embeds the LR-schedule position) for mid-training resume —
+    the Lightning ``Trainer.fit(ckpt_path=...)`` capability the reference
+    inherits. Restore takes the live sharded state as template, so snapshots
+    reload directly onto the mesh (and onto a *different* mesh, which torch
+    optimizer checkpoints cannot do without consolidation)."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 2):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    @staticmethod
+    def _tree(state) -> dict:
+        return {"step": state.step, "params": state.params, "opt_state": state.opt_state}
+
+    def save(self, step: int, state) -> None:
+        self._manager.save(step, args=ocp.args.StandardSave(self._tree(state)))
+        self._manager.wait_until_finished()
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def restore_latest(self, state):
+        """:param state: the freshly initialized sharded TrainState (shape,
+        dtype, and sharding template). :return: TrainState at the snapshot."""
+        step = self.latest_step
+        if step is None:
+            raise FileNotFoundError(f"no resume snapshots in {self.directory}")
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            self._tree(state),
+        )
+        restored = self._manager.restore(step, args=ocp.args.StandardRestore(target))
+        return state.replace(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+        )
+
+    def close(self):
+        self._manager.close()
+
+
 class BestCheckpointManager:
     """Keeps the k best checkpoints by ``val_loss`` — the reference's
     ``ModelCheckpoint(monitor="val_loss", save_weights_only=True)``

@@ -31,7 +31,10 @@ from perceiver_io_tpu.parallel import (
     make_train_step,
     shard_or_assemble,
 )
-from perceiver_io_tpu.training.checkpoint import BestCheckpointManager
+from perceiver_io_tpu.training.checkpoint import (
+    BestCheckpointManager,
+    ResumeCheckpointManager,
+)
 
 
 @dataclasses.dataclass
@@ -55,6 +58,15 @@ class TrainerConfig:
     #: capture a jax.profiler trace of steps [profile_start, profile_start+3)
     #: into <default_root_dir>/profile (None disables)
     profile_start: Optional[int] = None
+    #: snapshot the full TrainState (step, params, optimizer state) every N
+    #: steps into <default_root_dir>/resume for mid-training resume
+    save_state_every_n_steps: Optional[int] = None
+    #: resume from the latest TrainState snapshot in this directory (a
+    #: <root>/resume dir, or a root containing one) — Lightning
+    #: ``fit(ckpt_path=...)`` parity; the loss trajectory of a resumed run
+    #: matches the uninterrupted run exactly (per-step rng is fold_in-derived
+    #: and the data stream is fast-forwarded)
+    resume: Optional[str] = None
 
 
 class Trainer:
@@ -164,24 +176,58 @@ class Trainer:
         )
         rng = jax.random.PRNGKey(cfg.seed)
 
+        # The restore source may be a different run's dir and must not be
+        # rotated/pruned by this run's saves — restore first, then open the
+        # save manager on <default_root_dir>/resume.
+        start_step = 1
+        if cfg.resume is not None:
+            restore_mgr = ResumeCheckpointManager(self._resume_dir(cfg.resume))
+            try:
+                self.state = restore_mgr.restore_latest(self.state)
+            finally:
+                restore_mgr.close()
+            start_step = int(self.state.step) + 1
+            self.log_metrics(start_step - 1, {"resumed_at": start_step - 1})
+
+        resume_mgr: Optional[ResumeCheckpointManager] = None
+        if cfg.save_state_every_n_steps is not None:
+            resume_mgr = ResumeCheckpointManager(
+                os.path.join(cfg.default_root_dir, "resume")
+            )
+
         data_iter = iter(train_data)
+
+        def next_batch():
+            nonlocal data_iter
+            try:
+                return next(data_iter)
+            except StopIteration:
+                data_iter = iter(train_data)
+                try:
+                    return next(data_iter)
+                except StopIteration:
+                    raise ValueError(
+                        "train_data is exhausted and not re-iterable "
+                        "(one-shot generator?); pass a list or a loader"
+                    ) from None
+
+        # Replay the data stream to the resume point so a resumed run sees
+        # the same batches the uninterrupted run would (cheap for memmap
+        # loaders; for heavy streaming sources prefer checkpoint-aware
+        # sources like C4's per-shard offsets).
+        for _ in range(start_step - 1):
+            next_batch()
+
         window: list = []
         profiling = False
         t0 = time.time()
         with self.mesh:
-            for step_idx in range(1, cfg.max_steps + 1):
-                try:
-                    batch = next(data_iter)
-                except StopIteration:
-                    data_iter = iter(train_data)
-                    try:
-                        batch = next(data_iter)
-                    except StopIteration:
-                        raise ValueError(
-                            "train_data is exhausted and not re-iterable "
-                            "(one-shot generator?); pass a list or a loader"
-                        ) from None
-                rng, step_rng = jax.random.split(rng)
+            for step_idx in range(start_step, cfg.max_steps + 1):
+                batch = next_batch()
+                # fold_in (not sequential split): step k's rng is a pure
+                # function of (seed, k), so a resumed run replays the
+                # identical dropout/augmentation stream
+                step_rng = jax.random.fold_in(rng, step_idx)
                 batch = shard_or_assemble(batch, self.mesh, shard_seq=cfg.shard_seq)
                 if cfg.profile_start is not None and step_idx == cfg.profile_start:
                     jax.profiler.start_trace(
@@ -210,6 +256,12 @@ class Trainer:
                 if step_idx % cfg.log_every_n_steps == 0:
                     flush_window()
 
+                if (
+                    cfg.save_state_every_n_steps is not None
+                    and step_idx % cfg.save_state_every_n_steps == 0
+                ):
+                    resume_mgr.save(step_idx, self.state)
+
                 if val_data is not None and step_idx % cfg.val_check_interval == 0:
                     if window:  # flush partial window so steps_per_sec stays honest
                         flush_window()
@@ -228,7 +280,15 @@ class Trainer:
                     t0 = time.time()
             if profiling:  # max_steps ended inside the capture window
                 jax.profiler.stop_trace()
+        if resume_mgr is not None:
+            resume_mgr.close()
         return self.state
+
+    @staticmethod
+    def _resume_dir(path: str) -> str:
+        """Accept a ``<root>/resume`` dir or a root containing one."""
+        sub = os.path.join(path, "resume")
+        return sub if os.path.isdir(sub) else path
 
     def setup_state(
         self,

@@ -121,3 +121,46 @@ def test_image_classifier_logits_match():
 
     got = np.asarray(model.apply({"params": params}, jnp.asarray(images)))
     np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_optical_flow_logits_match():
+    """Flow conversion oracle (reference tests/optical_flow_test.py:27-36,
+    rebuilt offline with a random-init transformers model)."""
+    torch.manual_seed(0)
+    config = transformers.PerceiverConfig(
+        train_size=[6, 8],
+        d_model=322,  # 64 patch channels + 2*(2*64+1) fourier channels
+        d_latents=24,
+        num_latents=8,
+        num_blocks=1,
+        num_self_attends_per_block=2,
+        num_self_attention_heads=2,
+        num_cross_attention_heads=1,
+        qk_channels=None,
+        v_channels=None,
+        attention_probs_dropout_prob=0.0,
+        hidden_act="gelu",
+    )
+    from transformers.models.perceiver.modeling_perceiver import PerceiverForOpticalFlow
+
+    hf_model = PerceiverForOpticalFlow(config).eval()
+
+    from perceiver_io_tpu.convert.hf_import import (
+        import_hf_optical_flow,
+        optical_flow_config_from_hf,
+    )
+    from perceiver_io_tpu.models.vision.optical_flow import OpticalFlow
+
+    our_config = optical_flow_config_from_hf(config)
+    params = import_hf_optical_flow(hf_model.state_dict(), our_config)
+    model = OpticalFlow(our_config)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 2, 27, 6, 8)).astype(np.float32)
+
+    with torch.no_grad():
+        expected = hf_model(inputs=torch.tensor(x)).logits.numpy()
+
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(x)))
+    np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-4)

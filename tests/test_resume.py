@@ -1,0 +1,134 @@
+"""Mid-training resume: a killed-and-resumed run must land on exactly the
+state the uninterrupted run reaches (same seeds, same data order) — the
+Lightning ``Trainer.fit(ckpt_path=...)`` capability (VERDICT r2 ask #5)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+from perceiver_io_tpu.training.checkpoint import ResumeCheckpointManager
+from perceiver_io_tpu.training.tasks import clm_loss_fn
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+VOCAB, SEQ, LATENTS = 32, 16, 8
+
+
+def _model():
+    cfg = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=SEQ, max_latents=LATENTS, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.5,
+    )
+    return CausalLanguageModel(config=cfg), cfg
+
+
+def _batches(n):
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, VOCAB, (4, SEQ + 1), dtype=np.int64)
+        out.append({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+    return out
+
+
+def _fit(root, max_steps, *, save_every=None, resume=None):
+    model, cfg = _model()
+    mesh = make_mesh(MeshConfig(data=1))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=max_steps,
+            val_check_interval=10_000,
+            log_every_n_steps=10_000,
+            default_root_dir=str(root),
+            enable_checkpointing=False,
+            enable_tensorboard=False,
+            seed=7,
+            save_state_every_n_steps=save_every,
+            resume=resume,
+        ),
+        mesh,
+        clm_loss_fn(model, LATENTS),
+        optax.adamw(1e-3),
+        model_config=cfg,
+    )
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32),
+            SEQ - LATENTS,
+        )["params"]
+
+    state = trainer.fit(init_params, _batches(6))  # 6 batches, cycled
+    trainer.close()
+    return state
+
+
+def test_kill_and_resume_matches_uninterrupted(tmp_path):
+    straight = _fit(tmp_path / "straight", 9)
+
+    _fit(tmp_path / "killed", 5, save_every=5)  # "dies" after step 5
+    resumed = _fit(
+        tmp_path / "killed", 9, save_every=5, resume=str(tmp_path / "killed")
+    )
+
+    assert int(resumed.step) == int(straight.step) == 9
+    flat_a = jax.tree_util.tree_leaves(straight.params)
+    flat_b = jax.tree_util.tree_leaves(resumed.params)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+    # optimizer state (incl. adam moments / schedule count) must match too
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.opt_state),
+        jax.tree_util.tree_leaves(resumed.opt_state),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+
+
+def test_resume_manager_round_trip(tmp_path):
+    from perceiver_io_tpu.parallel import create_train_state
+
+    model, _ = _model()
+    mesh = make_mesh(MeshConfig(data=1))
+
+    def init():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS
+        )["params"]
+
+    state, _ = create_train_state(init, optax.adamw(1e-3), mesh)
+    state = state.replace(step=jnp.asarray(42, jnp.int32))
+
+    mgr = ResumeCheckpointManager(str(tmp_path / "resume"))
+    mgr.save(42, state)
+    assert mgr.latest_step == 42
+
+    fresh, _ = create_train_state(init, optax.adamw(1e-3), mesh)
+    restored = mgr.restore_latest(fresh)
+    mgr.close()
+    assert int(restored.step) == 42
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_without_snapshot_raises(tmp_path):
+    mgr = ResumeCheckpointManager(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore_latest(None)
+    mgr.close()
+
+
+def test_resume_into_new_root_does_not_touch_source(tmp_path):
+    """Resuming run A's snapshot into root B writes B's snapshots under
+    B/resume and leaves A's snapshot dir untouched."""
+    _fit(tmp_path / "runA", 4, save_every=2)
+    a_steps = sorted((tmp_path / "runA" / "resume").iterdir())
+
+    _fit(tmp_path / "runB", 6, save_every=2, resume=str(tmp_path / "runA"))
+    assert (tmp_path / "runB" / "resume").is_dir()
+    assert sorted((tmp_path / "runA" / "resume").iterdir()) == a_steps

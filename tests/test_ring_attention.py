@@ -71,3 +71,49 @@ def test_jit_under_mesh(rng, seq_mesh):
     np.testing.assert_allclose(
         f(q, k, v), _attention_xla(q, k, v, None, True, 0.0, None), atol=1e-5, rtol=1e-5
     )
+
+
+def test_model_level_ring_dispatch(rng):
+    """attention_impl='ring' reaches the model path (VERDICT r2 ask #9):
+    a CLM forward under a seq-sharded mesh must match the xla impl."""
+    from perceiver_io_tpu.models.text.clm import (
+        CausalLanguageModel,
+        CausalLanguageModelConfig,
+    )
+    from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = dict(
+        vocab_size=32, max_seq_len=32, max_latents=16, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    ring_model = CausalLanguageModel(
+        CausalLanguageModelConfig(**cfg), attention_impl="ring"
+    )
+    xla_model = CausalLanguageModel(
+        CausalLanguageModelConfig(**cfg), attention_impl="xla"
+    )
+    params = xla_model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 32), jnp.int32), 16
+    )["params"]
+    ids = jnp.asarray(rng.integers(1, 32, (2, 32)), jnp.int32)
+
+    mesh = make_mesh(MeshConfig(seq=4))
+    with mesh:
+        out_ring = ring_model.apply({"params": params}, ids, 16)
+    out_xla = xla_model.apply({"params": params}, ids, 16)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_xla), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_without_seq_mesh_falls_back_with_warning(rng):
+    # e.g. model.init outside the mesh context — ring degrades to the
+    # numerically identical einsum path and warns.
+    from perceiver_io_tpu.ops.attention import _attention_xla, dot_product_attention
+
+    q, k, v = _qkv(rng, 1, 2, 16, 16, 16)
+    with pytest.warns(UserWarning, match="seq"):
+        out = dot_product_attention(q, k, v, impl="ring", causal=True)
+    np.testing.assert_allclose(
+        out, _attention_xla(q, k, v, None, True, 0.0, None), atol=1e-6, rtol=1e-6
+    )
