@@ -160,6 +160,10 @@ def child_probe() -> None:
     print("PROBE_OK", flush=True)
 
 
+class MetricWithdrawn(RuntimeError):
+    """Deliberate refusal to publish (kernel mismatch, impossible MFU)."""
+
+
 class _Result:
     """Incrementally written result file: survives a mid-run child death."""
 
@@ -348,10 +352,10 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                         error=f"flash/xla loss mismatch {diff:.2e} — "
                         "kernel correctness regression; metric withdrawn"
                     )
-                    raise RuntimeError(res.data["error"])
-            except RuntimeError:
+                    raise MetricWithdrawn(res.data["error"])
+            except MetricWithdrawn:
                 raise
-            except Exception as e:
+            except Exception as e:  # backend failure here is not a verdict
                 log(f"run: cross-check skipped ({type(e).__name__}: {e})")
                 res.update(extras={**res.data["extras"], "flash_vs_xla": {
                     "error": f"{type(e).__name__}: {e}"}})
@@ -510,20 +514,24 @@ def _spawn(args, timeout, env_extra=None):
 
 def _read_result(out_path):
     """Accept whatever stages the child completed (file is written
-    incrementally); a file without the primary metric is no result."""
+    incrementally). Returns (result_or_None, withdrawal_error_or_None):
+    a file without the primary metric is no result, but a recorded
+    "error" (deliberate metric withdrawal) must reach the final JSON."""
     if os.path.exists(out_path) and os.path.getsize(out_path) > 0:
         try:
             with open(out_path) as f:
                 data = json.load(f)
-            if "value" in data:
-                return data
         except (json.JSONDecodeError, OSError):
-            return None
-    return None
+            return None, None
+        if "value" in data:
+            return data, None
+        return None, data.get("error")
+    return None, None
 
 
 def main() -> None:
     result = None
+    withdrawal = None
     note = []
 
     # Stage 1: probe the default (accelerator) backend, with retry/backoff.
@@ -549,23 +557,28 @@ def main() -> None:
             out_path = f.name
         log(f"accelerator benchmark (timeout {budget:.0f}s)")
         rc, _ = _spawn(["--run", "full", out_path, f"{budget - 10:.0f}"], timeout=budget)
-        result = _read_result(out_path)
-        if result is None:
+        result, withdrawal = _read_result(out_path)
+        if withdrawal:
+            note.append(f"metric withdrawn: {withdrawal}")
+            log(f"accelerator metric withdrawn: {withdrawal}")
+        elif result is None:
             note.append(f"accelerator benchmark failed rc={rc}")
             log(f"accelerator benchmark failed (rc={rc})")
         elif rc != 0:
             note.append(f"child exited rc={rc}; partial result accepted")
 
     # Stage 3: CPU fallback with reduced shapes so a measured number exists.
-    if result is None:
+    # A deliberate withdrawal (kernel mismatch) must NOT be papered over by
+    # a passing-looking CPU record — the zero record carries the error.
+    if result is None and not withdrawal:
         budget = max(60.0, remaining() - 20.0)
         with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
             out_path = f.name
         log(f"cpu fallback benchmark (timeout {budget:.0f}s)")
         rc, _ = _spawn(["--run", "cpu", out_path, f"{budget - 10:.0f}"], timeout=budget)
-        result = _read_result(out_path)
+        result, _withdrawal = _read_result(out_path)
         if result is not None:
-            note.append("accelerator unavailable; value measured on CPU at reduced shape")
+            note.append("value measured on CPU at reduced shape")
         else:
             note.append(f"cpu fallback failed rc={rc}")
             log(f"cpu fallback failed (rc={rc})")

@@ -88,9 +88,22 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate
         self.prefetch = prefetch
         self._epoch = 0
+        self._start_batch = 0
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+
+    def skip_batches(self, n: int) -> None:
+        """Advance the stream position by ``n`` batches in O(1) — the
+        resume fast-forward hook (the trainer uses this instead of
+        materializing and discarding ``n`` batches when available). The
+        position lands exactly where a continuous iteration would be:
+        ``n // len(self)`` epochs ahead, ``n % len(self)`` batches in."""
+        per_epoch = len(self)
+        if per_epoch == 0 or n <= 0:
+            return
+        self._epoch += n // per_epoch
+        self._start_batch = n % per_epoch
 
     def _epoch_indices(self) -> np.ndarray:
         n = len(self.dataset)
@@ -111,7 +124,9 @@ class DataLoader:
         limit = len(indices)
         if self.drop_last:
             limit = (limit // self.batch_size) * self.batch_size
-        for start in range(0, limit, self.batch_size):
+        first = self._start_batch * self.batch_size
+        self._start_batch = 0
+        for start in range(first, limit, self.batch_size):
             chunk = indices[start : start + self.batch_size]
             if not len(chunk):
                 return

@@ -106,14 +106,21 @@ class ResumeCheckpointManager:
     reload directly onto the mesh (and onto a *different* mesh, which torch
     optimizer checkpoints cannot do without consolidation)."""
 
-    def __init__(self, directory: str, *, max_to_keep: int = 2):
+    def __init__(self, directory: str, *, max_to_keep: int = 2, create: bool = True):
+        """:param create: make the directory (save side). Pass False for a
+        pure-read restore so a mistyped path fails cleanly instead of
+        leaving an empty directory tree behind."""
         self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        if create:
+            os.makedirs(self.directory, exist_ok=True)
+        elif not os.path.isdir(self.directory):
+            raise FileNotFoundError(f"no resume snapshots in {self.directory}")
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=False,
+                create=create,
             ),
         )
 

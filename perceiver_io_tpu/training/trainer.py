@@ -167,6 +167,37 @@ class Trainer:
             instead of ``init_params_fn``'s fresh init values.
         """
         cfg = self.config
+
+        # Preemption grace: TPU pods get a SIGTERM shortly before the machine
+        # is reclaimed. Install the handler BEFORE state setup — the initial
+        # compile can take minutes and a preemption during it must not kill
+        # the process uncleanly. The loop finishes the in-flight step,
+        # snapshots the TrainState, and exits so --resume continues exactly
+        # where the preempted run stopped.
+        prev_handler = None
+        self._preempted = False
+        if cfg.save_state_every_n_steps is not None:
+
+            def _on_sigterm(signum, frame):
+                self._preempted = True
+
+            try:
+                import signal
+
+                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:  # not the main thread — no signal hooks
+                prev_handler = None
+        try:
+            return self._fit_inner(
+                cfg, init_params_fn, train_data, val_data, initial_params
+            )
+        finally:
+            if prev_handler is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, prev_handler)
+
+    def _fit_inner(self, cfg, init_params_fn, train_data, val_data, initial_params):
         self.setup_state(init_params_fn, initial_params=initial_params)
         train_step = make_train_step(
             self.loss_fn,
@@ -181,7 +212,9 @@ class Trainer:
         # save manager on <default_root_dir>/resume.
         start_step = 1
         if cfg.resume is not None:
-            restore_mgr = ResumeCheckpointManager(self._resume_dir(cfg.resume))
+            restore_mgr = ResumeCheckpointManager(
+                self._resume_dir(cfg.resume), create=False
+            )
             try:
                 self.state = restore_mgr.restore_latest(self.state)
             finally:
@@ -212,12 +245,31 @@ class Trainer:
                     ) from None
 
         # Replay the data stream to the resume point so a resumed run sees
-        # the same batches the uninterrupted run would (cheap for memmap
-        # loaders; for heavy streaming sources prefer checkpoint-aware
-        # sources like C4's per-shard offsets).
-        for _ in range(start_step - 1):
-            next_batch()
+        # the same batches the uninterrupted run would. Loaders with a
+        # ``skip_batches`` hook (data.loader.DataLoader) fast-forward in
+        # O(1); anything else is consumed batch by batch.
+        if start_step > 1:
+            if hasattr(train_data, "skip_batches") and hasattr(train_data, "__len__"):
+                train_data.skip_batches(start_step - 1)
+                data_iter = iter(train_data)
+            else:
+                for _ in range(start_step - 1):
+                    next_batch()
 
+        try:
+            self._fit_loop(
+                cfg, train_step, rng, next_batch, val_data, resume_mgr, start_step
+            )
+        finally:
+            # even a crashed step must not leak the snapshot manager (the
+            # SIGTERM handler is restored by fit()'s own finally)
+            if resume_mgr is not None:
+                resume_mgr.close()
+        return self.state
+
+    def _fit_loop(
+        self, cfg, train_step, rng, next_batch, val_data, resume_mgr, start_step
+    ) -> None:
         window: list = []
         profiling = False
         t0 = time.time()
@@ -256,11 +308,14 @@ class Trainer:
                 if step_idx % cfg.log_every_n_steps == 0:
                     flush_window()
 
-                if (
-                    cfg.save_state_every_n_steps is not None
-                    and step_idx % cfg.save_state_every_n_steps == 0
+                if resume_mgr is not None and (
+                    step_idx % cfg.save_state_every_n_steps == 0
+                    or self._preempted
                 ):
                     resume_mgr.save(step_idx, self.state)
+                if resume_mgr is not None and self._preempted:
+                    self.log_metrics(step_idx, {"preempted_at": step_idx})
+                    break
 
                 if val_data is not None and step_idx % cfg.val_check_interval == 0:
                     if window:  # flush partial window so steps_per_sec stays honest
@@ -280,9 +335,6 @@ class Trainer:
                     t0 = time.time()
             if profiling:  # max_steps ended inside the capture window
                 jax.profiler.stop_trace()
-        if resume_mgr is not None:
-            resume_mgr.close()
-        return self.state
 
     @staticmethod
     def _resume_dir(path: str) -> str:

@@ -24,6 +24,7 @@ import pytest
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: multi-second integration test")
+    config.addinivalue_line("markers", "tpu: needs real TPU hardware (compiled Mosaic path)")
 
 
 @pytest.fixture

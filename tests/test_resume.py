@@ -132,3 +132,94 @@ def test_resume_into_new_root_does_not_touch_source(tmp_path):
     _fit(tmp_path / "runB", 6, save_every=2, resume=str(tmp_path / "runA"))
     assert (tmp_path / "runB" / "resume").is_dir()
     assert sorted((tmp_path / "runA" / "resume").iterdir()) == a_steps
+
+
+def test_skip_batches_matches_continuous_stream():
+    """DataLoader.skip_batches(n) lands exactly where continuous iteration
+    would be, across epoch boundaries (the O(1) resume fast-forward)."""
+    from perceiver_io_tpu.data.loader import DataLoader
+
+    data = [{"x": np.asarray([i])} for i in range(10)]
+    def stream(loader, count):
+        out = []
+        while len(out) < count:
+            for b in loader:
+                out.append(int(b["x"][0, 0]))
+                if len(out) == count:
+                    break
+        return out
+
+    a = DataLoader(data, batch_size=2, shuffle=True, seed=3,
+                   shard_index=0, shard_count=1, prefetch=0)
+    continuous = stream(a, 12)  # crosses into epoch 2
+
+    b = DataLoader(data, batch_size=2, shuffle=True, seed=3,
+                   shard_index=0, shard_count=1, prefetch=0)
+    b.skip_batches(7)
+    resumed = stream(b, 5)
+    assert resumed == continuous[7:]
+
+
+def test_sigterm_preemption_snapshots_and_resumes(tmp_path):
+    """SIGTERM mid-fit finishes the in-flight step, snapshots, and exits;
+    --resume then continues to the same final state as an uninterrupted
+    run (TPU preemption grace)."""
+    import os
+    import signal
+
+    straight = _fit(tmp_path / "straight", 8)
+
+    model, cfg = _model()
+    mesh = make_mesh(MeshConfig(data=1))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=8, val_check_interval=10_000, log_every_n_steps=10_000,
+            default_root_dir=str(tmp_path / "preempted"),
+            enable_checkpointing=False, enable_tensorboard=False, seed=7,
+            save_state_every_n_steps=100,  # periodic saves alone would never fire
+        ),
+        mesh, clm_loss_fn(model, LATENTS), optax.adamw(1e-3), model_config=cfg,
+    )
+
+    class Preempting:
+        """Re-iterable batch source that SIGTERMs its own process while
+        batch 4 is being fetched (i.e. during step 4)."""
+
+        def __init__(self, batches):
+            self.batches = batches
+            self.served = 0
+
+        def __iter__(self):
+            for b in self.batches:
+                self.served += 1
+                if self.served == 4:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS,
+        )["params"]
+
+    state = trainer.fit(init_params, Preempting(_batches(6)))
+    trainer.close()
+    assert int(state.step) == 4  # stopped after the in-flight step
+
+    resumed = _fit(
+        tmp_path / "preempted", 8, save_every=100, resume=str(tmp_path / "preempted")
+    )
+    assert int(resumed.step) == 8
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.params),
+        jax.tree_util.tree_leaves(resumed.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+
+
+def test_mistyped_resume_path_fails_clean(tmp_path):
+    """A wrong --trainer.resume path must raise without creating dirs."""
+    missing = tmp_path / "no-such-run"
+    with pytest.raises(FileNotFoundError):
+        ResumeCheckpointManager(str(missing), create=False)
+    assert not missing.exists()
