@@ -406,24 +406,43 @@ def _bench_mlm(mesh, platform: str):
     from perceiver_io_tpu.training.tasks import mlm_loss_fn
 
     if platform == "tpu":
+        # deepmind/language-perceiver: qk 256 / v 1280, widening 1 (the HF
+        # PerceiverConfig defaults) — 201M params exactly, not the reference
+        # library's widening-4 defaults.
         seq, vocab, batch = 2048, 262, 8
         channels, latents, latent_channels, layers = 768, 256, 1280, 26
+        qk, widen = 256, 1
         config_note = "deepmind/language-perceiver 201M (768ch, 256x1280 latents, 26 layers)"
     else:  # CPU fallback: same architecture, reduced shape
         seq, vocab, batch = 512, 262, 2
         channels, latents, latent_channels, layers = 256, 64, 512, 4
+        qk, widen = 128, 1
         config_note = "reduced CPU shape (256ch, 64x512 latents, 4 layers)"
     cfg = MaskedLanguageModelConfig(
         encoder=TextEncoderConfig(
             vocab_size=vocab,
             max_seq_len=seq,
             num_input_channels=channels,
+            num_cross_attention_qk_channels=qk,
+            num_cross_attention_v_channels=latent_channels,
             num_cross_attention_heads=8,
+            num_self_attention_qk_channels=qk,
+            num_self_attention_v_channels=latent_channels,
             num_self_attention_heads=8,
             num_self_attention_layers_per_block=layers,
             num_self_attention_blocks=1,
+            cross_attention_widening_factor=widen,
+            self_attention_widening_factor=widen,
         ),
-        decoder=TextDecoderConfig(vocab_size=vocab, max_seq_len=seq),
+        decoder=TextDecoderConfig(
+            vocab_size=vocab,
+            max_seq_len=seq,
+            num_cross_attention_qk_channels=qk,
+            num_cross_attention_v_channels=channels,
+            num_cross_attention_heads=8,
+            cross_attention_widening_factor=widen,
+            cross_attention_residual=False,
+        ),
         num_latents=latents,
         num_latent_channels=latent_channels,
     )
