@@ -19,6 +19,8 @@ def text_generation(model_dir: str) -> None:
         "text-generation", model_dir, ByteTokenizer(padding_side="left")
     )
     print(pipe("A man walked into", max_new_tokens=64, num_latents=64, top_k=40)[0])
+    # deterministic beam decode (HF generate(num_beams=k) semantics)
+    print(pipe("A man walked into", max_new_tokens=64, num_latents=64, num_beams=4)[0])
 
 
 def fill_mask(model_dir: str) -> None:

@@ -14,4 +14,7 @@ python -m perceiver_io_tpu.scripts.text.clm fit \
   --lr_scheduler.warmup_steps=200 \
   --trainer.max_steps=25000 \
   --trainer.val_check_interval=1000 \
+  --trainer.save_state_every_n_steps=1000 \
   --trainer.default_root_dir=logs/clm
+# Preempted? Re-run with --trainer.resume=logs/clm to continue exactly
+# where the snapshot left off (same loss trajectory).

@@ -67,6 +67,12 @@ class TrainerConfig:
     #: matches the uninterrupted run exactly (per-step rng is fold_in-derived
     #: and the data stream is fast-forwarded)
     resume: Optional[str] = None
+    #: halt when the train loss goes non-finite — checked at each log flush
+    #: and before every TrainState snapshot (a diverged state is never
+    #: snapshotted, so existing snapshots stay a finite resume point); the
+    #: device queue is never stalled per-step (Lightning ``detect_anomaly``
+    #: role)
+    terminate_on_non_finite: bool = True
 
 
 class Trainer:
@@ -304,6 +310,14 @@ class Trainer:
                     mean["steps_per_sec"] = len(window) / (time.time() - t0)
                     self.log_metrics(step_idx, mean, prefix="train/")
                     window, t0 = [], time.time()
+                    if cfg.terminate_on_non_finite and not np.isfinite(
+                        mean.get("loss", 0.0)
+                    ):
+                        raise FloatingPointError(
+                            f"train loss went non-finite at step {step_idx} "
+                            f"({mean['loss']}); halting — resume from the last "
+                            "snapshot with a lower lr / grad clip"
+                        )
 
                 if step_idx % cfg.log_every_n_steps == 0:
                     flush_window()
@@ -312,6 +326,16 @@ class Trainer:
                     step_idx % cfg.save_state_every_n_steps == 0
                     or self._preempted
                 ):
+                    if cfg.terminate_on_non_finite and not np.isfinite(
+                        float(metrics.get("loss", 0.0))
+                    ):
+                        # never snapshot a diverged state — the existing
+                        # snapshots stay the last-finite resume point
+                        raise FloatingPointError(
+                            f"train loss went non-finite by step {step_idx}; "
+                            "snapshot refused — resume from the previous "
+                            "snapshot with a lower lr / grad clip"
+                        )
                     resume_mgr.save(step_idx, self.state)
                 if resume_mgr is not None and self._preempted:
                     self.log_metrics(step_idx, {"preempted_at": step_idx})

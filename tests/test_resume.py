@@ -223,3 +223,62 @@ def test_mistyped_resume_path_fails_clean(tmp_path):
     with pytest.raises(FileNotFoundError):
         ResumeCheckpointManager(str(missing), create=False)
     assert not missing.exists()
+
+
+def test_non_finite_loss_halts(tmp_path):
+    """terminate_on_non_finite: a diverged run raises at the log flush
+    instead of burning the rest of the step budget on NaNs."""
+    model, cfg = _model()
+    mesh = make_mesh(MeshConfig(data=1))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=6, val_check_interval=10_000, log_every_n_steps=2,
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+            enable_tensorboard=False, seed=7,
+        ),
+        mesh,
+        clm_loss_fn(model, LATENTS),
+        optax.sgd(1e38),  # guaranteed blow-up
+        model_config=cfg,
+    )
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS,
+        )["params"]
+
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        trainer.fit(init_params, _batches(4))
+    trainer.close()
+
+
+def test_non_finite_state_never_snapshotted(tmp_path):
+    """Snapshot cadence finer than the log cadence must not capture NaN
+    params: the save itself refuses a diverged state."""
+    model, cfg = _model()
+    mesh = make_mesh(MeshConfig(data=1))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=6, val_check_interval=10_000, log_every_n_steps=1000,
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+            enable_tensorboard=False, seed=7, save_state_every_n_steps=2,
+        ),
+        mesh,
+        clm_loss_fn(model, LATENTS),
+        optax.sgd(1e38),
+        model_config=cfg,
+    )
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS,
+        )["params"]
+
+    with pytest.raises(FloatingPointError, match="snapshot refused"):
+        trainer.fit(init_params, _batches(4))
+    trainer.close()
+    resume_dir = tmp_path / "resume"
+    step_dirs = [d for d in resume_dir.iterdir() if d.name.isdigit()] if resume_dir.exists() else []
+    assert not step_dirs, f"diverged state was snapshotted: {step_dirs}"
