@@ -59,9 +59,6 @@ def beam_search(
     b, prompt_len = input_ids.shape
     n = model.max_seq_len
     max_latents = model.max_latents
-    k = num_beams
-    t_max = config.max_new_tokens
-    vocab = model.config.vocab_size
     if not 0 < prompt_len <= n:
         raise ValueError(f"prompt length out of valid range [1..{n}]")
     if not 0 < config.num_latents <= max_latents:
@@ -77,117 +74,162 @@ def beam_search(
         )
     if prompt_pad_count is None:
         prompt_pad_count = jnp.zeros((b,), jnp.int32)
+    executor = _beam_executor(
+        model, config, b, prompt_len, num_latents, num_beams,
+        float(length_penalty), str(input_ids.dtype),
+    )
+    return executor(params, input_ids, prompt_pad_count)
+
+
+_EXECUTOR_CACHE: dict = {}
+
+
+def _beam_executor(
+    model, config, b: int, prompt_len: int, num_latents: int,
+    num_beams: int, length_penalty: float, ids_dtype: str,
+):
+    """Compile-once beam program per static plan (same rationale and keying
+    as ``generate._generation_executor`` — the eager body re-traced the
+    whole scan on every call)."""
+    from perceiver_io_tpu.inference.generate import cached_executor, model_fingerprint
+
+    key = (
+        type(model).__qualname__, model_fingerprint(model), config,
+        b, prompt_len, num_latents, num_beams, length_penalty, ids_dtype,
+    )
+    return cached_executor(
+        _EXECUTOR_CACHE, key,
+        lambda: _build_beam_executor(
+            model, config, b, prompt_len, num_latents, num_beams,
+            length_penalty, ids_dtype,
+        ),
+        max_entries=32,
+    )
+
+
+def _build_beam_executor(
+    model, config, b: int, prompt_len: int, num_latents: int,
+    num_beams: int, length_penalty: float, ids_dtype: str,
+):
+    n = model.max_seq_len
+    max_latents = model.max_latents
+    k = num_beams
+    t_max = config.max_new_tokens
+    vocab = model.config.vocab_size
     eos = config.eos_token_id
     min_new = min(config.min_new_tokens, t_max) if eos is not None else t_max
 
-    # Beams ride the batch axis: (b, k, ...) flattened to (b*k, ...).
-    window = jnp.full((b, n), config.pad_token_id, input_ids.dtype)
-    window = window.at[:, n - prompt_len :].set(input_ids)
-    window = jnp.repeat(window, k, axis=0)
-    pad_count = jnp.repeat(
-        prompt_pad_count.astype(jnp.int32) + (n - prompt_len), k, axis=0
-    )
-    beam_scores = jnp.full((b, k), NEG_INF, jnp.float32).at[:, 0].set(0.0)
-
-    rows = jnp.arange(b)[:, None]  # (b, 1) batch index for beam gathers
-
-    def step(carry, t):
-        window, pad_count, m, beam_scores, tok_buf, hyp_scores, hyp_tokens = carry
-
-        logits = model.apply(
-            {"params": params}, window, pad_count, m, method=_decode_forward
-        )  # (b*k, V)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        if eos is not None:
-            logp = jnp.where(
-                (t < min_new) & (jnp.arange(vocab) == eos)[None, :], -jnp.inf, logp
-            )
-        scores = (beam_scores.reshape(b * k, 1) + logp).reshape(b, k * vocab)
-
-        # Top-2k candidates (sorted descending, as HF), then the first k
-        # non-EOS candidates continue as live beams.
-        cand_scores, cand_idx = jax.lax.top_k(scores, 2 * k)
-        cand_beam = cand_idx // vocab  # (b, 2k)
-        cand_tok = (cand_idx % vocab).astype(jnp.int32)
-
-        if eos is not None:
-            is_eos = cand_tok == eos
-            # EOS candidates ranked among the first k enter the hypothesis
-            # buffer, length-normalized at insertion (HF BeamHypotheses.add:
-            # keep the k best, displacing the worst). Up to k candidates can
-            # finish in one step — statically unrolled best-first inserts.
-            in_first_k = jnp.arange(2 * k)[None, :] < k
-            hyp_cand_score = jnp.where(
-                is_eos & in_first_k,
-                cand_scores / ((t + 1.0) ** length_penalty),
-                -jnp.inf,
-            )
-            for _ in range(k):
-                best_e = jnp.argmax(hyp_cand_score, axis=1)  # (b,)
-                best_score = jnp.take_along_axis(
-                    hyp_cand_score, best_e[:, None], 1
-                )[:, 0]
-                src_beam = jnp.take_along_axis(cand_beam, best_e[:, None], 1)[:, 0]
-                hist = tok_buf[rows[:, 0], src_beam]  # (b, t_max)
-                hist = jnp.where(jnp.arange(t_max)[None, :] == t, eos, hist)
-                worst = jnp.argmin(hyp_scores, axis=1)  # (b,)
-                worst_score = jnp.take_along_axis(hyp_scores, worst[:, None], 1)[:, 0]
-                replace = best_score > worst_score
-                hyp_scores = hyp_scores.at[rows[:, 0], worst].set(
-                    jnp.where(replace, best_score, worst_score)
-                )
-                old_rows = hyp_tokens[rows[:, 0], worst]
-                hyp_tokens = hyp_tokens.at[rows[:, 0], worst].set(
-                    jnp.where(replace[:, None], hist, old_rows)
-                )
-                # consume this candidate
-                hyp_cand_score = hyp_cand_score.at[rows[:, 0], best_e].set(-jnp.inf)
-            # Live beams: first k non-EOS candidates, in candidate order
-            # (stable sort on the EOS flag preserves score order).
-            order = jnp.argsort(is_eos.astype(jnp.int32), axis=1, stable=True)
-            live = order[:, :k]
-        else:
-            live = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
-
-        new_scores = jnp.take_along_axis(cand_scores, live, 1)  # (b, k)
-        new_beam = jnp.take_along_axis(cand_beam, live, 1)
-        new_tok = jnp.take_along_axis(cand_tok, live, 1)
-
-        # Reindex beam state, then advance the windows with the new tokens.
-        window = window.reshape(b, k, n)[rows, new_beam].reshape(b * k, n)
-        pad_count = pad_count.reshape(b, k)[rows, new_beam].reshape(b * k)
-        tok_buf = tok_buf[rows, new_beam]
-        tok_buf = jnp.where(
-            (jnp.arange(t_max) == t)[None, None, :], new_tok[..., None], tok_buf
+    def run(params, input_ids, prompt_pad_count):
+        # Beams ride the batch axis: (b, k, ...) flattened to (b*k, ...).
+        window = jnp.full((b, n), config.pad_token_id, input_ids.dtype)
+        window = window.at[:, n - prompt_len :].set(input_ids)
+        window = jnp.repeat(window, k, axis=0)
+        pad_count = jnp.repeat(
+            prompt_pad_count.astype(jnp.int32) + (n - prompt_len), k, axis=0
         )
-        window = jnp.concatenate(
-            [window[:, 1:], new_tok.reshape(b * k, 1).astype(window.dtype)], axis=1
+        beam_scores = jnp.full((b, k), NEG_INF, jnp.float32).at[:, 0].set(0.0)
+
+        rows = jnp.arange(b)[:, None]  # (b, 1) batch index for beam gathers
+
+        def step(carry, t):
+            window, pad_count, m, beam_scores, tok_buf, hyp_scores, hyp_tokens = carry
+
+            logits = model.apply(
+                {"params": params}, window, pad_count, m, method=_decode_forward
+            )  # (b*k, V)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            if eos is not None:
+                logp = jnp.where(
+                    (t < min_new) & (jnp.arange(vocab) == eos)[None, :], -jnp.inf, logp
+                )
+            scores = (beam_scores.reshape(b * k, 1) + logp).reshape(b, k * vocab)
+
+            # Top-2k candidates (sorted descending, as HF), then the first k
+            # non-EOS candidates continue as live beams.
+            cand_scores, cand_idx = jax.lax.top_k(scores, 2 * k)
+            cand_beam = cand_idx // vocab  # (b, 2k)
+            cand_tok = (cand_idx % vocab).astype(jnp.int32)
+
+            if eos is not None:
+                is_eos = cand_tok == eos
+                # EOS candidates ranked among the first k enter the hypothesis
+                # buffer, length-normalized at insertion (HF BeamHypotheses.add:
+                # keep the k best, displacing the worst). Up to k candidates can
+                # finish in one step — statically unrolled best-first inserts.
+                in_first_k = jnp.arange(2 * k)[None, :] < k
+                hyp_cand_score = jnp.where(
+                    is_eos & in_first_k,
+                    cand_scores / ((t + 1.0) ** length_penalty),
+                    -jnp.inf,
+                )
+                for _ in range(k):
+                    best_e = jnp.argmax(hyp_cand_score, axis=1)  # (b,)
+                    best_score = jnp.take_along_axis(
+                        hyp_cand_score, best_e[:, None], 1
+                    )[:, 0]
+                    src_beam = jnp.take_along_axis(cand_beam, best_e[:, None], 1)[:, 0]
+                    hist = tok_buf[rows[:, 0], src_beam]  # (b, t_max)
+                    hist = jnp.where(jnp.arange(t_max)[None, :] == t, eos, hist)
+                    worst = jnp.argmin(hyp_scores, axis=1)  # (b,)
+                    worst_score = jnp.take_along_axis(hyp_scores, worst[:, None], 1)[:, 0]
+                    replace = best_score > worst_score
+                    hyp_scores = hyp_scores.at[rows[:, 0], worst].set(
+                        jnp.where(replace, best_score, worst_score)
+                    )
+                    old_rows = hyp_tokens[rows[:, 0], worst]
+                    hyp_tokens = hyp_tokens.at[rows[:, 0], worst].set(
+                        jnp.where(replace[:, None], hist, old_rows)
+                    )
+                    # consume this candidate
+                    hyp_cand_score = hyp_cand_score.at[rows[:, 0], best_e].set(-jnp.inf)
+                # Live beams: first k non-EOS candidates, in candidate order
+                # (stable sort on the EOS flag preserves score order).
+                order = jnp.argsort(is_eos.astype(jnp.int32), axis=1, stable=True)
+                live = order[:, :k]
+            else:
+                live = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+
+            new_scores = jnp.take_along_axis(cand_scores, live, 1)  # (b, k)
+            new_beam = jnp.take_along_axis(cand_beam, live, 1)
+            new_tok = jnp.take_along_axis(cand_tok, live, 1)
+
+            # Reindex beam state, then advance the windows with the new tokens.
+            window = window.reshape(b, k, n)[rows, new_beam].reshape(b * k, n)
+            pad_count = pad_count.reshape(b, k)[rows, new_beam].reshape(b * k)
+            tok_buf = tok_buf[rows, new_beam]
+            tok_buf = jnp.where(
+                (jnp.arange(t_max) == t)[None, None, :], new_tok[..., None], tok_buf
+            )
+            window = jnp.concatenate(
+                [window[:, 1:], new_tok.reshape(b * k, 1).astype(window.dtype)], axis=1
+            )
+            pad_count = jnp.maximum(pad_count - 1, 0)
+            m = jnp.minimum(m + 1, max_latents)
+
+            carry = (window, pad_count, m, new_scores, tok_buf, hyp_scores, hyp_tokens)
+            return carry, None
+
+        tok_buf = jnp.zeros((b, k, t_max), jnp.int32)
+        hyp_scores = jnp.full((b, k), -jnp.inf, jnp.float32)
+        hyp_tokens = jnp.full((b, k, t_max), config.pad_token_id, jnp.int32)
+        carry = (
+            window,
+            pad_count,
+            jnp.asarray(num_latents, jnp.int32),
+            beam_scores,
+            tok_buf,
+            hyp_scores,
+            hyp_tokens,
         )
-        pad_count = jnp.maximum(pad_count - 1, 0)
-        m = jnp.minimum(m + 1, max_latents)
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(t_max))
+        _, _, _, beam_scores, tok_buf, hyp_scores, hyp_tokens = carry
 
-        carry = (window, pad_count, m, new_scores, tok_buf, hyp_scores, hyp_tokens)
-        return carry, None
+        # Finalize (HF with early_stopping=False at max length): live beams join
+        # the hypothesis pool, length-normalized at generated length.
+        live_final = beam_scores / (float(t_max) ** length_penalty)
+        all_scores = jnp.concatenate([hyp_scores, live_final], axis=1)  # (b, 2k)
+        all_tokens = jnp.concatenate([hyp_tokens, tok_buf], axis=1)  # (b, 2k, t_max)
+        best = jnp.argmax(all_scores, axis=1)
+        return all_tokens[jnp.arange(b), best].astype(input_ids.dtype)
 
-    tok_buf = jnp.zeros((b, k, t_max), jnp.int32)
-    hyp_scores = jnp.full((b, k), -jnp.inf, jnp.float32)
-    hyp_tokens = jnp.full((b, k, t_max), config.pad_token_id, jnp.int32)
-    carry = (
-        window,
-        pad_count,
-        jnp.asarray(num_latents, jnp.int32),
-        beam_scores,
-        tok_buf,
-        hyp_scores,
-        hyp_tokens,
-    )
-    carry, _ = jax.lax.scan(step, carry, jnp.arange(t_max))
-    _, _, _, beam_scores, tok_buf, hyp_scores, hyp_tokens = carry
-
-    # Finalize (HF with early_stopping=False at max length): live beams join
-    # the hypothesis pool, length-normalized at generated length.
-    live_final = beam_scores / (float(t_max) ** length_penalty)
-    all_scores = jnp.concatenate([hyp_scores, live_final], axis=1)  # (b, 2k)
-    all_tokens = jnp.concatenate([hyp_tokens, tok_buf], axis=1)  # (b, 2k, t_max)
-    best = jnp.argmax(all_scores, axis=1)
-    return all_tokens[jnp.arange(b), best].astype(input_ids.dtype)
+    return jax.jit(run)

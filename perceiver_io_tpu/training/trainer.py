@@ -21,6 +21,7 @@ import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -73,6 +74,18 @@ class TrainerConfig:
     #: device queue is never stalled per-step (Lightning ``detect_anomaly``
     #: role)
     terminate_on_non_finite: bool = True
+
+
+@jax.jit
+def _params_finite(params) -> jnp.ndarray:
+    """Device-side all-finite reduction over a param tree (one fused pass;
+    used to guard TrainState snapshots against persisting diverged state)."""
+    leaves = [
+        jnp.isfinite(x).all()
+        for x in jax.tree_util.tree_leaves(params)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+    ]
+    return jnp.all(jnp.stack(leaves)) if leaves else jnp.asarray(True)
 
 
 class Trainer:
@@ -326,13 +339,14 @@ class Trainer:
                     step_idx % cfg.save_state_every_n_steps == 0
                     or self._preempted
                 ):
-                    if cfg.terminate_on_non_finite and not np.isfinite(
-                        float(metrics.get("loss", 0.0))
+                    # the loss is computed on PRE-update params, so it can
+                    # be finite while the update just overflowed — check the
+                    # post-update state itself before persisting it
+                    if cfg.terminate_on_non_finite and not _params_finite(
+                        self.state.params
                     ):
-                        # never snapshot a diverged state — the existing
-                        # snapshots stay the last-finite resume point
                         raise FloatingPointError(
-                            f"train loss went non-finite by step {step_idx}; "
+                            f"params went non-finite by step {step_idx}; "
                             "snapshot refused — resume from the previous "
                             "snapshot with a lower lr / grad clip"
                         )
