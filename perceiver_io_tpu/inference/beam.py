@@ -118,6 +118,7 @@ def _build_beam_executor(
     vocab = model.config.vocab_size
     eos = config.eos_token_id
     min_new = min(config.min_new_tokens, t_max) if eos is not None else t_max
+    rep_penalty = config.sampling.repetition_penalty
 
     def run(params, input_ids, prompt_pad_count):
         # Beams ride the batch axis: (b, k, ...) flattened to (b*k, ...).
@@ -138,6 +139,17 @@ def _build_beam_executor(
                 {"params": params}, window, pad_count, m, method=_decode_forward
             )  # (b*k, V)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            if rep_penalty != 1.0:
+                # HF beam order: processors run on the log-probs
+                # (modeling _beam_search: log_softmax then logits_processor)
+                from perceiver_io_tpu.inference.samplers import (
+                    apply_repetition_penalty,
+                )
+
+                logp = apply_repetition_penalty(
+                    logp, window, rep_penalty,
+                    jnp.arange(n)[None, :] < pad_count[:, None],
+                )
             if eos is not None:
                 logp = jnp.where(
                     (t < min_new) & (jnp.arange(vocab) == eos)[None, :], -jnp.inf, logp

@@ -438,6 +438,11 @@ def generate(
     return executor(params, input_ids, rng, prompt_pad_count)
 
 
+def _pad_positions(pad_count: jnp.ndarray, n: int) -> jnp.ndarray:
+    """(b, n) True where the right-aligned window slot is left padding."""
+    return jnp.arange(n)[None, :] < pad_count[:, None]
+
+
 _FINGERPRINTS: dict = {}  # id(model) -> (weakref, repr string)
 
 
@@ -543,7 +548,10 @@ def _build_generation_executor(
 
             def cached_step(carry, step_rng):
                 window, pad_count, finished, logits, cache, length, m = carry
-                token = sample_logits(step_rng, logits, config.sampling)
+                token = sample_logits(
+                    step_rng, logits, config.sampling,
+                    window, _pad_positions(pad_count, n),
+                )
                 window, pad_count, finished, token, _ = advance(
                     window, pad_count, finished, token, m
                 )
@@ -563,7 +571,10 @@ def _build_generation_executor(
 
             def boundary_step(carry, step_rng):
                 window, pad_count, finished, logits, cross_k, cross_v, length = carry
-                token = sample_logits(step_rng, logits, config.sampling)
+                token = sample_logits(
+                    step_rng, logits, config.sampling,
+                    window, _pad_positions(pad_count, n),
+                )
                 window, pad_count, finished, token, _ = advance(
                     window, pad_count, finished, token, m_full
                 )
@@ -594,7 +605,10 @@ def _build_generation_executor(
                 logits = model.apply(
                     {"params": params}, window, pad_count, m, method=_decode_forward
                 )
-                token = sample_logits(step_rng, logits, config.sampling)
+                token = sample_logits(
+                    step_rng, logits, config.sampling,
+                    window, _pad_positions(pad_count, n),
+                )
                 window, pad_count, finished, token, m = advance(
                     window, pad_count, finished, token, m
                 )

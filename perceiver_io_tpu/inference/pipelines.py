@@ -79,6 +79,7 @@ class TextGenerationPipeline(_Pipeline):
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        repetition_penalty: float = 1.0,
         num_beams: int = 1,
         length_penalty: float = 1.0,
         seed: int = 0,
@@ -99,7 +100,8 @@ class TextGenerationPipeline(_Pipeline):
             eos_token_id=self.tokenizer.eos_token_id,
             num_beams=num_beams,
             length_penalty=length_penalty,
-            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p),
+            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p,
+                                    repetition_penalty=repetition_penalty),
         )
         out = generate(
             self.model,
@@ -243,6 +245,7 @@ class SymbolicAudioPipeline(_Pipeline):
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        repetition_penalty: float = 1.0,
         num_beams: int = 1,
         length_penalty: float = 1.0,
         seed: int = 0,
@@ -265,7 +268,8 @@ class SymbolicAudioPipeline(_Pipeline):
             pad_token_id=PAD_TOKEN,
             num_beams=num_beams,
             length_penalty=length_penalty,
-            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p),
+            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p,
+                                    repetition_penalty=repetition_penalty),
         )
         out = generate(
             self.model,

@@ -22,6 +22,34 @@ class SamplingConfig:
     temperature: float = 1.0
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    #: HF ``RepetitionPenaltyLogitsProcessor``: tokens already in the context
+    #: get ``score/p`` (if positive) or ``score*p`` (if negative). 1.0 = off.
+    repetition_penalty: float = 1.0
+
+
+def apply_repetition_penalty(
+    logits: jnp.ndarray,
+    context_ids: jnp.ndarray,
+    penalty: float,
+    context_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """HF ``RepetitionPenaltyLogitsProcessor`` semantics: for every token id
+    present in ``context_ids``, divide its (positive) logit by ``penalty`` or
+    multiply a negative logit by it.
+
+    :param logits: ``(b, vocab)``.
+    :param context_ids: ``(b, n)`` token history (e.g. the decode window).
+    :param context_mask: optional ``(b, n)`` True = IGNORE this position
+        (padding slots must not penalize the pad token id).
+    """
+    b, vocab = logits.shape
+    ids = context_ids
+    if context_mask is not None:
+        ids = jnp.where(context_mask, vocab, ids)  # out-of-range → dropped
+    seen = jnp.zeros((b, vocab + 1), bool).at[jnp.arange(b)[:, None], ids].set(True)
+    seen = seen[:, :vocab]
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
 
 
 def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -49,11 +77,21 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
 
 
 def sample_logits(
-    rng: jax.Array, logits: jnp.ndarray, config: SamplingConfig
+    rng: jax.Array, logits: jnp.ndarray, config: SamplingConfig,
+    context_ids: Optional[jnp.ndarray] = None,
+    context_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """:param logits: ``(b, vocab)`` next-token logits.
+    :param context_ids: ``(b, n)`` token history for the repetition penalty
+        (ignored when ``config.repetition_penalty == 1.0``).
+    :param context_mask: ``(b, n)`` True = ignore this history position.
     :return: ``(b,)`` int32 sampled token ids."""
     logits = logits.astype(jnp.float32)
+    if config.repetition_penalty != 1.0 and context_ids is not None:
+        # processors run before the greedy argmax too (HF order)
+        logits = apply_repetition_penalty(
+            logits, context_ids, config.repetition_penalty, context_mask
+        )
     if not config.do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if config.temperature != 1.0:
