@@ -36,7 +36,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from perceiver_io_tpu.inference.generate import GenerationConfig, _decode_forward
+from perceiver_io_tpu.inference.generate import (
+    GenerationConfig,
+    _decode_forward,
+    _pad_positions,
+)
+from perceiver_io_tpu.inference.samplers import apply_repetition_penalty
 
 NEG_INF = -1e9
 
@@ -142,13 +147,8 @@ def _build_beam_executor(
             if rep_penalty != 1.0:
                 # HF beam order: processors run on the log-probs
                 # (modeling _beam_search: log_softmax then logits_processor)
-                from perceiver_io_tpu.inference.samplers import (
-                    apply_repetition_penalty,
-                )
-
                 logp = apply_repetition_penalty(
-                    logp, window, rep_penalty,
-                    jnp.arange(n)[None, :] < pad_count[:, None],
+                    logp, window, rep_penalty, _pad_positions(pad_count, n)
                 )
             if eos is not None:
                 logp = jnp.where(

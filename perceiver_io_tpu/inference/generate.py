@@ -527,6 +527,22 @@ def _build_generation_executor(
         m = jnp.minimum(m + 1, max_latents)
         return window, pad_count, finished, token, m
 
+    # HF MinNewTokensLengthLogitsProcessor: EOS is unreachable until
+    # min_new_tokens have been generated (applies to greedy and sampling,
+    # not only beam).
+    min_new = (
+        min(config.min_new_tokens, config.max_new_tokens)
+        if config.eos_token_id is not None
+        else 0
+    )
+
+    def mask_eos_until_min(logits, t):
+        if min_new <= 0:
+            return logits
+        vocab = logits.shape[-1]
+        blocked = (t < min_new) & (jnp.arange(vocab) == config.eos_token_id)[None, :]
+        return jnp.where(blocked, -jnp.inf, logits)
+
     def run(params, input_ids, rng, prompt_pad_count):
         # Right-align the prompt into the full-size window.
         window = jnp.full((b, n), config.pad_token_id, input_ids.dtype)
@@ -546,10 +562,11 @@ def _build_generation_executor(
 
         if s1 > 0:
 
-            def cached_step(carry, step_rng):
+            def cached_step(carry, xs):
+                step_rng, t = xs
                 window, pad_count, finished, logits, cache, length, m = carry
                 token = sample_logits(
-                    step_rng, logits, config.sampling,
+                    step_rng, mask_eos_until_min(logits, t), config.sampling,
                     window, _pad_positions(pad_count, n),
                 )
                 window, pad_count, finished, token, _ = advance(
@@ -561,7 +578,9 @@ def _build_generation_executor(
                 return (window, pad_count, finished, logits, cache, length, m), token
 
             carry = (window, pad_count, finished, logits, cache, length, m0)
-            carry, tokens = jax.lax.scan(cached_step, carry, step_rngs[:s1])
+            carry, tokens = jax.lax.scan(
+                cached_step, carry, (step_rngs[:s1], jnp.arange(s1))
+            )
             window, pad_count, finished, logits, cache, length, m0 = carry
             token_blocks.append(tokens)
 
@@ -569,10 +588,11 @@ def _build_generation_executor(
             cross_k, cross_v = cache["cross_k"], cache["cross_v"]
             m_full = jnp.asarray(max_latents, jnp.int32)
 
-            def boundary_step(carry, step_rng):
+            def boundary_step(carry, xs):
+                step_rng, t = xs
                 window, pad_count, finished, logits, cross_k, cross_v, length = carry
                 token = sample_logits(
-                    step_rng, logits, config.sampling,
+                    step_rng, mask_eos_until_min(logits, t), config.sampling,
                     window, _pad_positions(pad_count, n),
                 )
                 window, pad_count, finished, token, _ = advance(
@@ -593,20 +613,23 @@ def _build_generation_executor(
                 )
 
             carry = (window, pad_count, finished, logits, cross_k, cross_v, length)
-            carry, tokens = jax.lax.scan(boundary_step, carry, step_rngs[s1:s2])
+            carry, tokens = jax.lax.scan(
+                boundary_step, carry, (step_rngs[s1:s2], jnp.arange(s1, s2))
+            )
             window, pad_count, finished = carry[0], carry[1], carry[2]
             m0 = m_full
             token_blocks.append(tokens)
 
         if config.max_new_tokens > s2:
 
-            def step(carry, step_rng):
+            def step(carry, xs):
+                step_rng, t = xs
                 window, pad_count, m, finished = carry
                 logits = model.apply(
                     {"params": params}, window, pad_count, m, method=_decode_forward
                 )
                 token = sample_logits(
-                    step_rng, logits, config.sampling,
+                    step_rng, mask_eos_until_min(logits, t), config.sampling,
                     window, _pad_positions(pad_count, n),
                 )
                 window, pad_count, finished, token, m = advance(
@@ -615,7 +638,9 @@ def _build_generation_executor(
                 return (window, pad_count, m, finished), token
 
             carry = (window, pad_count, m0, finished)
-            _, tokens = jax.lax.scan(step, carry, step_rngs[s2:])
+            _, tokens = jax.lax.scan(
+                step, carry, (step_rngs[s2:], jnp.arange(s2, config.max_new_tokens))
+            )
             token_blocks.append(tokens)
 
         return jnp.concatenate(token_blocks, axis=0).T.astype(

@@ -75,6 +75,7 @@ class TextGenerationPipeline(_Pipeline):
         prompts: Union[str, Sequence[str]],
         *,
         max_new_tokens: int = 64,
+        min_new_tokens: int = 0,
         num_latents: int = 1,
         temperature: float = 1.0,
         top_k: Optional[int] = None,
@@ -95,6 +96,7 @@ class TextGenerationPipeline(_Pipeline):
 
         config = GenerationConfig(
             max_new_tokens=max_new_tokens,
+            min_new_tokens=min_new_tokens,
             num_latents=num_latents,
             pad_token_id=pad_id,
             eos_token_id=self.tokenizer.eos_token_id,
@@ -241,6 +243,7 @@ class SymbolicAudioPipeline(_Pipeline):
         prompts: Union[Sequence[int], Sequence[Sequence[int]], "np.ndarray"],
         *,
         max_new_tokens: int = 256,
+        min_new_tokens: int = 0,
         num_latents: int = 1,
         temperature: float = 1.0,
         top_k: Optional[int] = None,
@@ -264,6 +267,7 @@ class SymbolicAudioPipeline(_Pipeline):
 
         config = GenerationConfig(
             max_new_tokens=max_new_tokens,
+            min_new_tokens=min_new_tokens,
             num_latents=num_latents,
             pad_token_id=PAD_TOKEN,
             num_beams=num_beams,
