@@ -41,7 +41,10 @@ from perceiver_io_tpu.inference.generate import (
     _decode_forward,
     _pad_positions,
 )
-from perceiver_io_tpu.inference.samplers import apply_repetition_penalty
+from perceiver_io_tpu.inference.samplers import (
+    apply_min_new_tokens,
+    apply_repetition_penalty,
+)
 
 NEG_INF = -1e9
 
@@ -151,9 +154,7 @@ def _build_beam_executor(
                     logp, window, rep_penalty, _pad_positions(pad_count, n)
                 )
             if eos is not None:
-                logp = jnp.where(
-                    (t < min_new) & (jnp.arange(vocab) == eos)[None, :], -jnp.inf, logp
-                )
+                logp = apply_min_new_tokens(logp, t, min_new, eos)
             scores = (beam_scores.reshape(b * k, 1) + logp).reshape(b, k * vocab)
 
             # Top-2k candidates (sorted descending, as HF), then the first k

@@ -61,7 +61,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
+from perceiver_io_tpu.inference.samplers import (
+    SamplingConfig,
+    apply_min_new_tokens,
+    sample_logits,
+)
 from perceiver_io_tpu.ops.position import RotaryEmbedding, positions
 
 
@@ -78,7 +82,8 @@ class GenerationConfig:
     #: HF exponent on generated length when ranking hypotheses (matches the
     #: vectorized ``_beam_search`` in transformers >= 4.50).
     length_penalty: float = 1.0
-    #: EOS is masked to -inf until this many new tokens exist (beam search).
+    #: EOS is masked to -inf until this many new tokens exist — greedy,
+    #: sampled, and beam decoding alike (HF MinNewTokensLengthLogitsProcessor).
     min_new_tokens: int = 0
 
 
@@ -527,9 +532,8 @@ def _build_generation_executor(
         m = jnp.minimum(m + 1, max_latents)
         return window, pad_count, finished, token, m
 
-    # HF MinNewTokensLengthLogitsProcessor: EOS is unreachable until
-    # min_new_tokens have been generated (applies to greedy and sampling,
-    # not only beam).
+    # EOS unreachable until min_new_tokens (applies to greedy and sampling,
+    # not only beam — HF MinNewTokensLengthLogitsProcessor).
     min_new = (
         min(config.min_new_tokens, config.max_new_tokens)
         if config.eos_token_id is not None
@@ -537,11 +541,7 @@ def _build_generation_executor(
     )
 
     def mask_eos_until_min(logits, t):
-        if min_new <= 0:
-            return logits
-        vocab = logits.shape[-1]
-        blocked = (t < min_new) & (jnp.arange(vocab) == config.eos_token_id)[None, :]
-        return jnp.where(blocked, -jnp.inf, logits)
+        return apply_min_new_tokens(logits, t, min_new, config.eos_token_id or 0)
 
     def run(params, input_ids, rng, prompt_pad_count):
         # Right-align the prompt into the full-size window.

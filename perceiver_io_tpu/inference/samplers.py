@@ -27,6 +27,19 @@ class SamplingConfig:
     repetition_penalty: float = 1.0
 
 
+def apply_min_new_tokens(
+    logits: jnp.ndarray, t: jnp.ndarray, min_new: int, eos_token_id: int
+) -> jnp.ndarray:
+    """HF ``MinNewTokensLengthLogitsProcessor``: EOS is unreachable until
+    ``min_new`` tokens have been generated. ``t`` is the 0-based global
+    generation step. No-op when ``min_new <= 0`` (static)."""
+    if min_new <= 0:
+        return logits
+    vocab = logits.shape[-1]
+    blocked = (t < min_new) & (jnp.arange(vocab) == eos_token_id)[None, :]
+    return jnp.where(blocked, -jnp.inf, logits)
+
+
 def apply_repetition_penalty(
     logits: jnp.ndarray,
     context_ids: jnp.ndarray,
