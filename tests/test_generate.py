@@ -474,3 +474,32 @@ class TestMinNewTokens:
         cached = generate(j_model, params, ids, cfg, use_cache=True)
         recomputed = generate(j_model, params, ids, cfg, use_cache=False)
         np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomputed))
+
+
+class TestSamplerHFParity:
+    """Filter parity against transformers' own warpers on shared logits
+    (the unit tests above pin our semantics; these pin HF equivalence)."""
+
+    def test_top_k_matches_hf(self):
+        from transformers import TopKLogitsWarper
+
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((4, 64)).astype(np.float32)
+        expected = TopKLogitsWarper(top_k=7)(None, torch.tensor(logits)).numpy()
+        got = np.asarray(apply_top_k(jnp.asarray(logits), 7))
+        # HF masks with -inf, ours with float32 min — compare the survivors
+        np.testing.assert_array_equal(np.isfinite(got) & (got > NEG_INF),
+                                      np.isfinite(expected))
+        keep = np.isfinite(expected)
+        np.testing.assert_allclose(got[keep], expected[keep], rtol=1e-6)
+
+    def test_top_p_matches_hf(self):
+        from transformers import TopPLogitsWarper
+
+        rng = np.random.default_rng(1)
+        logits = rng.standard_normal((4, 64)).astype(np.float32)
+        expected = TopPLogitsWarper(top_p=0.8)(None, torch.tensor(logits)).numpy()
+        got = np.asarray(apply_top_p(jnp.asarray(logits), 0.8))
+        np.testing.assert_array_equal(got > NEG_INF, np.isfinite(expected))
+        keep = np.isfinite(expected)
+        np.testing.assert_allclose(got[keep], expected[keep], rtol=1e-6)

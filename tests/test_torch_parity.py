@@ -333,7 +333,7 @@ class TestGradientParity:
             return -ll.mean()
 
         j_loss, j_grads = jax.value_and_grad(loss_fn)(params)
-        np.testing.assert_allclose(float(j_loss), float(t_loss), rtol=1e-5)
+        np.testing.assert_allclose(float(j_loss), t_loss.item(), rtol=1e-5)
 
         flat_t = jax.tree_util.tree_leaves_with_path(t_grads)
         flat_j = dict(jax.tree_util.tree_leaves_with_path(j_grads))
@@ -395,7 +395,7 @@ class TestGradientParity:
             return -ll.mean()
 
         j_loss, j_grads = jax.value_and_grad(loss_fn)(params)
-        np.testing.assert_allclose(float(j_loss), float(t_loss), rtol=1e-5)
+        np.testing.assert_allclose(float(j_loss), t_loss.item(), rtol=1e-5)
         flat_j = dict(jax.tree_util.tree_leaves_with_path(j_grads))
         checked = 0
         for path, tg in jax.tree_util.tree_leaves_with_path(t_grads):
