@@ -1,0 +1,143 @@
+"""On-hardware tuning sweep for the AR train step.
+
+Runs one subprocess per configuration (fresh jit cache, fresh env knobs, hard
+timeout so a hung backend cannot take the sweep down) and records chained
+step times with the value-fetch fencing from ``bench.py`` — the only timing
+this backend cannot fake (see ``docs/benchmarks.md``).
+
+Swept knobs:
+- ``attention_impl``: flash vs xla end-to-end
+- ``PERCEIVER_FLASH_MIN_KV``: auto-dispatch floor — xla for the short
+  (1024×1024) self-attention, flash for the long-kv cross-attention
+- ``PERCEIVER_FLASH_BLOCKS``: Pallas block-size schedule
+
+Usage::
+
+    python examples/perf/tune_step.py            # bench shape, full sweep
+    python examples/perf/tune_step.py --quick    # small shape smoke
+    python examples/perf/tune_step.py --out results.json
+
+Exit is always 0 with a JSON summary on stdout; individual config failures
+and timeouts are recorded, not fatal.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (heavy imports inside bench are function-local)
+
+FULL_SHAPE = bench.FULL_SHAPE
+QUICK_SHAPE = (2, 2048, 256, 256, 8, 2)
+
+SWEEP = [
+    {"name": "flash-default", "impl": "auto", "env": {}},
+    {"name": "flash-minkv2048", "impl": "auto", "env": {"PERCEIVER_FLASH_MIN_KV": "2048"}},
+    {"name": "flash-minkv1536", "impl": "auto", "env": {"PERCEIVER_FLASH_MIN_KV": "1536"}},
+    {"name": "flash-blocks1024", "impl": "auto", "env": {"PERCEIVER_FLASH_BLOCKS": "1024,512,256,128"}},
+    {"name": "flash-blocks256", "impl": "auto", "env": {"PERCEIVER_FLASH_BLOCKS": "256,128"}},
+    {
+        "name": "flash-blocks1024-minkv2048",
+        "impl": "auto",
+        "env": {"PERCEIVER_FLASH_BLOCKS": "1024,512,256,128", "PERCEIVER_FLASH_MIN_KV": "2048"},
+    },
+    {"name": "xla", "impl": "xla", "env": {}},
+]
+
+
+def child(shape, impl: str) -> None:
+    import jax
+    import numpy as np
+
+    from perceiver_io_tpu.parallel import shard_batch, single_device_mesh
+
+    cfg = bench._mk_config(shape)
+    batch_size = shape[0]
+    mesh = single_device_mesh(jax.devices()[0])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch_size, cfg.max_seq_len + 1), dtype=np.int32)
+    with mesh:
+        sharded = shard_batch({"input_ids": ids[:, :-1], "labels": ids[:, 1:]}, mesh)
+        _, state, step = bench._build_ar(cfg, mesh, impl)
+        chained_ms, synced_ms, _, loss = bench._time_train(
+            step, state, sharded, jax.random.PRNGKey(1), n_chain=20, n_sync=2
+        )
+    print(json.dumps({
+        "chained_ms": round(chained_ms, 2),
+        "synced_ms": round(synced_ms, 2),
+        "loss": round(loss, 4),
+        "tokens_per_sec": round(batch_size * cfg.max_seq_len / (chained_ms / 1e3), 1),
+    }), flush=True)
+
+
+def ceiling_child() -> None:
+    print(json.dumps({"matmul_tflops": round(bench._matmul_ceiling_tflops(), 1)}), flush=True)
+
+
+def run_one(args_list, env_extra, timeout_s):
+    # Start from an env with every PERCEIVER_FLASH_* knob stripped: configs
+    # must see exactly the knobs they declare, not leftovers from the shell.
+    env = {k: v for k, v in os.environ.items() if not k.startswith("PERCEIVER_FLASH_")}
+    env.update(env_extra)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *args_list],
+            env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+            text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "timeout", "wall_s": round(time.monotonic() - t0, 1)}
+    if proc.returncode != 0:
+        return {"error": f"rc={proc.returncode}", "wall_s": round(time.monotonic() - t0, 1)}
+    for line in (proc.stdout or "").splitlines()[::-1]:
+        try:
+            out = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(out, dict):
+            out["wall_s"] = round(time.monotonic() - t0, 1)
+            return out
+    return {"error": "no JSON result on stdout", "wall_s": round(time.monotonic() - t0, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+    shape = QUICK_SHAPE if args.quick else FULL_SHAPE
+    shape_arg = ",".join(map(str, shape))
+
+    results = {"shape": list(shape), "configs": {}}
+    print(f"[tune] matmul ceiling...", file=sys.stderr, flush=True)
+    results["ceiling"] = run_one(["--ceiling"], {}, min(args.timeout, 300.0))
+    print(f"[tune] ceiling: {results['ceiling']}", file=sys.stderr, flush=True)
+
+    for cfg in SWEEP:
+        print(f"[tune] {cfg['name']}...", file=sys.stderr, flush=True)
+        r = run_one(["--child", shape_arg, cfg["impl"]], cfg["env"], args.timeout)
+        results["configs"][cfg["name"]] = r
+        print(f"[tune] {cfg['name']}: {r}", file=sys.stderr, flush=True)
+
+    print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(tuple(int(x) for x in sys.argv[2].split(",")), sys.argv[3])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--ceiling":
+        ceiling_child()
+    else:
+        main()

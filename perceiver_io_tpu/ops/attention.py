@@ -139,6 +139,18 @@ def _flash_eligible(q, k, v, dropout_rate) -> bool:
     # is dropout 0.0 everywhere; training configs that enable it fall back).
     if dropout_rate > 0.0:
         return False
+    # Optional kv-length floor for 'auto' (PERCEIVER_FLASH_MIN_KV): below it,
+    # the materialized XLA softmax is cheap and the blockwise schedule's
+    # per-block overhead can dominate — lets short self-attention use XLA
+    # while long-kv cross-attention stays flash. Default 0 = flash everywhere.
+    import os
+
+    try:
+        min_kv = int(os.environ.get("PERCEIVER_FLASH_MIN_KV", "0"))
+    except ValueError:
+        min_kv = 0
+    if k.shape[2] < min_kv:
+        return False
     try:
         platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
     except Exception:

@@ -80,6 +80,34 @@ def test_supported_gating():
     assert not flash_attention.supported(q3, k3, v3, causal=False)
 
 
+def test_block_candidates_env_override(monkeypatch):
+    monkeypatch.setenv("PERCEIVER_FLASH_BLOCKS", "1024,256")
+    assert flash_attention._candidates() == (1024, 256)
+    assert flash_attention._pick_block(512) == 256
+    assert flash_attention._pick_block(2048) == 1024
+    # invalid values are ignored in favor of the default
+    monkeypatch.setenv("PERCEIVER_FLASH_BLOCKS", "100,abc")
+    assert flash_attention._candidates() == flash_attention._BLOCK_CANDIDATES
+    monkeypatch.delenv("PERCEIVER_FLASH_BLOCKS")
+    assert flash_attention._pick_block(512) == 512
+
+
+def test_min_kv_env_gates_auto_dispatch(rng, monkeypatch):
+    from perceiver_io_tpu.ops import attention
+
+    q, k, v = _qkv(rng, 1, 2, 128, 256, 64)
+    monkeypatch.setenv("PERCEIVER_FLASH_MIN_KV", "512")
+    assert not attention._flash_eligible(q, k, v, 0.0)  # kv 256 < floor 512
+    monkeypatch.setenv("PERCEIVER_FLASH_MIN_KV", "256")
+    # kv >= floor: eligibility now depends only on the platform gate
+    assert attention._flash_eligible(q, k, v, 0.0) == (jax.default_backend() == "tpu")
+    # explicit impl='flash' ignores the auto floor
+    monkeypatch.setenv("PERCEIVER_FLASH_MIN_KV", "4096")
+    out = dot_product_attention(q, k, v, causal=True, impl="flash")
+    expected = dot_product_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(out, expected, atol=2e-5, rtol=2e-5)
+
+
 def test_dispatch_impl_flash(rng):
     q, k, v = _qkv(rng, 1, 2, 128, 256, 64)
     out = dot_product_attention(q, k, v, causal=True, impl="flash")
