@@ -109,6 +109,7 @@ def make_train_step(
     *,
     grad_clip_norm: Optional[float] = None,
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ):
     """Build the jitted SPMD training step.
 
@@ -118,15 +119,57 @@ def make_train_step(
     :param grad_clip_norm: optional global-norm clipping *after* the gradient
         allreduce (matching the FSDP script's manual ``clip_grad_norm_``,
         reference ``clm_fsdp.py:59-67``); also logs the pre-clip grad norm.
+    :param grad_accum_steps: gradient accumulation (the role of Lightning's
+        ``accumulate_grad_batches``, which the reference's CLM/SAM runs use,
+        reference ``examples/training/clm/train.py:50``) — the batch is split
+        into this many equal microbatches along dim 0 and a ``lax.scan``
+        inside the step averages their gradients before the single optimizer
+        update; peak activation memory is one microbatch's. NOTE the batch
+        semantics differ from Lightning: Lightning accumulates across N
+        loader batches (multiplying the effective batch), this DIVIDES the
+        given batch — pass the full effective batch. Averaging is
+        mean-of-microbatch-means, the same semantics DDP+accumulation gives
+        the reference (per-microbatch masked means weight microbatches
+        equally even if their mask counts differ).
     :return: jitted ``(state, batch, rng) -> (state, metrics)``. Batches must
         be placed with :func:`~perceiver_io_tpu.parallel.shard_batch` (their
         committed sharding propagates; ``in_shardings`` pins only the state so
         heterogeneous batch pytrees — 2-D tokens, 4-D images — all work).
     """
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+
+    def value_and_grads(params, batch, rng):
+        if grad_accum_steps == 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(params, batch, rng)
+
+        def to_micro(x):
+            if x.shape[0] % grad_accum_steps:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"grad_accum_steps={grad_accum_steps}"
+                )
+            return x.reshape(grad_accum_steps, x.shape[0] // grad_accum_steps, *x.shape[1:])
+
+        micro = jax.tree_util.tree_map(to_micro, batch)
+        keys = None if rng is None else jax.random.split(rng, grad_accum_steps)
+
+        def body(g_sum, xs):
+            mb, r = xs if keys is not None else (xs, None)
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb, r
+            )
+            return jax.tree_util.tree_map(jnp.add, g_sum, grads), (loss, metrics)
+
+        g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        xs = (micro, keys) if keys is not None else micro
+        g_sum, (losses, metrics) = jax.lax.scan(body, g0, xs)
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum_steps, g_sum)
+        metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), metrics)
+        return (jnp.mean(losses), metrics), grads
+
     def step(state: TrainState, batch, rng):
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, rng
-        )
+        (loss, metrics), grads = value_and_grads(state.params, batch, rng)
         if grad_clip_norm is not None:
             gnorm = optax.global_norm(grads)
             scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))

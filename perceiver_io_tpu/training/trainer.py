@@ -50,6 +50,12 @@ class TrainerConfig:
     default_root_dir: str = "logs"
     max_checkpoints: int = 1
     grad_clip_norm: Optional[float] = None
+    #: split each batch into N microbatches and average their gradients
+    #: inside the jitted step. NOTE: unlike Lightning's
+    #: ``accumulate_grad_batches`` (which multiplies the loader batch), this
+    #: DIVIDES the given batch — pass the full effective batch size and use
+    #: this knob to bound activation memory per microbatch
+    grad_accum_steps: int = 1
     seed: int = 0
     enable_checkpointing: bool = True
     enable_tensorboard: bool = True
@@ -223,6 +229,7 @@ class Trainer:
             self.mesh,
             self._shardings,
             grad_clip_norm=cfg.grad_clip_norm,
+            grad_accum_steps=cfg.grad_accum_steps,
         )
         rng = jax.random.PRNGKey(cfg.seed)
 

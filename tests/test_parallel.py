@@ -64,7 +64,8 @@ def make_batch(rng, batch_size=8):
     return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
 
-def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14, shard_seq=False):
+def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14, shard_seq=False,
+              grad_accum_steps=1):
     model = tiny_clm()
     mesh = make_mesh(mesh_config)
     rng = np.random.default_rng(0)
@@ -78,7 +79,8 @@ def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14, shard_s
     tx = optax.adam(1e-2)
     state, shardings = create_train_state(init, tx, mesh, min_fsdp_size=min_fsdp_size)
     step = make_train_step(
-        make_loss_fn(model, prefix_len), mesh, shardings, grad_clip_norm=1.0
+        make_loss_fn(model, prefix_len), mesh, shardings, grad_clip_norm=1.0,
+        grad_accum_steps=grad_accum_steps,
     )
 
     losses = []
@@ -127,6 +129,23 @@ def test_sequence_parallel_matches_single_device(baseline, mesh_config):
     and inserts the collectives (the reference has no equivalent)."""
     losses, _, _ = run_steps(mesh_config, shard_seq=True)
     np.testing.assert_allclose(losses, baseline, rtol=2e-4)
+
+
+@pytest.mark.parametrize("accum,mesh_config", [
+    (2, MeshConfig(data=1)),
+    (4, MeshConfig(data=2)),
+], ids=["accum2", "accum4xdp2"])
+def test_grad_accumulation_matches_full_batch(baseline, accum, mesh_config):
+    """A step over N microbatches must equal the full-batch step: equal-sized
+    microbatch means average to the global mean, so the loss trajectory is
+    identical (Lightning ``accumulate_grad_batches`` parity semantics)."""
+    losses, _, _ = run_steps(mesh_config, grad_accum_steps=accum)
+    np.testing.assert_allclose(losses, baseline, rtol=2e-4)
+
+
+def test_grad_accumulation_rejects_indivisible_batch():
+    with pytest.raises(ValueError, match="not divisible"):
+        run_steps(MeshConfig(data=1), batch_size=6, grad_accum_steps=4)
 
 
 def test_fsdp_actually_shards_params_and_opt_state():
