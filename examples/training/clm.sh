@@ -1,15 +1,18 @@
 #!/usr/bin/env bash
 # Perceiver AR causal LM on WikiText-103 raw (UTF-8 bytes) — the reference's
-# examples/training/clm/train.sh configuration on a TPU mesh.
+# examples/training/clm/train.sh configuration on a TPU mesh. Effective batch
+# 80 = the reference's 20/device x 2 devices x accumulate_grad_batches=2;
+# grad_accum_steps=4 bounds activation memory to 20-row microbatches.
 python -m perceiver_io_tpu.scripts.text.clm fit \
   --data=wikitext \
   --data.dataset_dir=.cache/wikitext \
   --data.max_seq_len=4096 \
-  --data.batch_size=24 \
+  --data.batch_size=80 \
   --model.max_latents=512 \
   --model.num_channels=512 \
   --model.num_self_attention_layers=8 \
   --model.cross_attention_dropout=0.5 \
+  --trainer.grad_accum_steps=4 \
   --optimizer.lr=2e-4 \
   --lr_scheduler.warmup_steps=200 \
   --trainer.max_steps=25000 \

@@ -1,11 +1,14 @@
 #!/usr/bin/env bash
 # Perceiver AR symbolic audio on GiantMIDI — reference examples/training/sam.
+# Effective batch 32 = the reference's 8/device x 2 devices x
+# accumulate_grad_batches=2; 8-row microbatches via grad_accum_steps=4.
 python -m perceiver_io_tpu.scripts.audio.symbolic fit \
   --data=giantmidi \
   --data.dataset_dir=.cache/giantmidi \
   --data.max_seq_len=6144 \
   --data.min_seq_len=4096 \
-  --data.batch_size=8 \
+  --data.batch_size=32 \
+  --trainer.grad_accum_steps=4 \
   --model.max_latents=2048 \
   --model.num_channels=768 \
   --optimizer.lr=2e-4 \

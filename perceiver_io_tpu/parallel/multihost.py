@@ -79,7 +79,7 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
-def global_batch(batch, mesh, *, shard_seq: bool = False):
+def global_batch(batch, mesh, *, shard_seq: bool = False, stacked_steps: bool = False):
     """Assemble per-host batch arrays into global ``jax.Array``s.
 
     Every process passes its *local* slice (``local_batch = global_batch /
@@ -87,20 +87,29 @@ def global_batch(batch, mesh, *, shard_seq: bool = False):
     single logical array laid out by the batch sharding, with each host's
     rows resident on its own devices — the TPU-native replacement for the
     reference's rank-local DataLoader + DDP gradient sync.
+
+    With ``stacked_steps`` the leaves carry a leading ``(n_steps, ...)`` dim
+    (multi-step-in-jit); the *batch* dim (dim 1) is the per-host one.
     """
 
     def assemble(x):
         x = np.asarray(x)
-        sharding = batch_sharding(mesh, ndim=x.ndim, shard_seq=shard_seq)
-        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        sharding = batch_sharding(
+            mesh, ndim=x.ndim, shard_seq=shard_seq, stacked_steps=stacked_steps
+        )
+        if stacked_steps:
+            global_shape = (
+                x.shape[0], x.shape[1] * jax.process_count()) + x.shape[2:]
+        else:
+            global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree_util.tree_map(assemble, batch)
 
 
-def shard_or_assemble(batch, mesh, *, shard_seq: bool = False):
+def shard_or_assemble(batch, mesh, *, shard_seq: bool = False, stacked_steps: bool = False):
     """Single-process: ``shard_batch`` (device_put). Multi-process:
     :func:`global_batch` (process-local assembly)."""
     if is_multihost():
-        return global_batch(batch, mesh, shard_seq=shard_seq)
-    return shard_batch(batch, mesh, shard_seq=shard_seq)
+        return global_batch(batch, mesh, shard_seq=shard_seq, stacked_steps=stacked_steps)
+    return shard_batch(batch, mesh, shard_seq=shard_seq, stacked_steps=stacked_steps)

@@ -122,20 +122,32 @@ def shard_params(params, mesh: Mesh):
     return jax.device_put(params, param_shardings(params, mesh))
 
 
-def batch_spec(mesh: Mesh, *, ndim: int = 2, shard_seq: bool = False) -> P:
+def batch_spec(
+    mesh: Mesh, *, ndim: int = 2, shard_seq: bool = False, stacked_steps: bool = False
+) -> P:
     """PartitionSpec for a batch array: leading dim over (data, fsdp), and
-    optionally the sequence dim over ``seq`` (context parallelism)."""
+    optionally the sequence dim over ``seq`` (context parallelism).
+    ``stacked_steps`` marks arrays with an extra leading steps dim — shape
+    ``(n_steps, batch, ...)`` for the multi-step-in-jit train loop — which is
+    scanned over, never sharded."""
     spec: list = [BATCH_AXES] + [None] * (ndim - 1)
-    if shard_seq and ndim > 1 and mesh.shape.get(AXIS_SEQ, 1) > 1:
-        spec[1] = AXIS_SEQ
+    if stacked_steps:
+        spec = [None, BATCH_AXES] + [None] * (ndim - 2)
+    seq_dim = 2 if stacked_steps else 1
+    if shard_seq and ndim > seq_dim and mesh.shape.get(AXIS_SEQ, 1) > 1:
+        spec[seq_dim] = AXIS_SEQ
     return P(*spec)
 
 
-def batch_sharding(mesh: Mesh, *, ndim: int = 2, shard_seq: bool = False) -> NamedSharding:
-    return NamedSharding(mesh, batch_spec(mesh, ndim=ndim, shard_seq=shard_seq))
+def batch_sharding(
+    mesh: Mesh, *, ndim: int = 2, shard_seq: bool = False, stacked_steps: bool = False
+) -> NamedSharding:
+    return NamedSharding(
+        mesh, batch_spec(mesh, ndim=ndim, shard_seq=shard_seq, stacked_steps=stacked_steps)
+    )
 
 
-def shard_batch(batch, mesh: Mesh, *, shard_seq: bool = False):
+def shard_batch(batch, mesh: Mesh, *, shard_seq: bool = False, stacked_steps: bool = False):
     """Device-put a pytree of host batch arrays with batch-dim sharding.
 
     On multi-host pods, per-host arrays should instead be assembled with
@@ -144,7 +156,10 @@ def shard_batch(batch, mesh: Mesh, *, shard_seq: bool = False):
     """
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(
-            x, batch_sharding(mesh, ndim=np.ndim(x), shard_seq=shard_seq)
+            x,
+            batch_sharding(
+                mesh, ndim=np.ndim(x), shard_seq=shard_seq, stacked_steps=stacked_steps
+            ),
         ),
         batch,
     )

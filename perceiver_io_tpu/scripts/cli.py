@@ -226,7 +226,8 @@ class CLI:
 
     def main(self, argv: Optional[Sequence[str]] = None) -> Any:
         argv = list(sys.argv[1:] if argv is None else argv)
-        if not argv or argv[0] in ("-h", "--help"):
+        if not argv or any(a in ("-h", "--help") for a in argv):
+            # help anywhere in argv (e.g. `fit --help`), like jsonargparse
             self._print_help()
             return None
         subcommand = argv[0]

@@ -56,13 +56,18 @@ class TrainerConfig:
     #: DIVIDES the given batch — pass the full effective batch size and use
     #: this knob to bound activation memory per microbatch
     grad_accum_steps: int = 1
+    #: run N optimizer steps per device program (``lax.scan`` over stacked
+    #: batches) — amortizes host dispatch latency; steps that need host-side
+    #: work (validation, snapshots, profiling) automatically run singly.
+    #: Trades preemption-response latency (≤ N steps) for throughput.
+    steps_per_execution: int = 1
     seed: int = 0
     enable_checkpointing: bool = True
     enable_tensorboard: bool = True
     #: shard the sequence dim of batches over the ``seq`` mesh axis
     #: (context parallelism; XLA partitions attention over kv accordingly)
     shard_seq: bool = False
-    #: capture a jax.profiler trace of steps [profile_start, profile_start+3)
+    #: capture a jax.profiler trace of _PROFILE_WINDOW steps starting here
     #: into <default_root_dir>/profile (None disables)
     profile_start: Optional[int] = None
     #: snapshot the full TrainState (step, params, optimizer state) every N
@@ -80,6 +85,10 @@ class TrainerConfig:
     #: device queue is never stalled per-step (Lightning ``detect_anomaly``
     #: role)
     terminate_on_non_finite: bool = True
+
+
+#: steps traced per jax.profiler capture: [profile_start, profile_start + _PROFILE_WINDOW)
+_PROFILE_WINDOW = 3
 
 
 @jax.jit
@@ -293,31 +302,88 @@ class Trainer:
                 resume_mgr.close()
         return self.state
 
+    def _block_ok(self, cfg, start: int, k: int, val_data, resume_mgr) -> bool:
+        """Whether steps ``[start, start+k-1]`` may run as one device program:
+        no step *interior* to the block (the last one is handled after the
+        block returns) needs host-side work — validation, state snapshot, or
+        the profiler capture window."""
+        if start + k - 1 > cfg.max_steps or self._preempted:
+            return False
+        for idx in range(start, start + k - 1):
+            if resume_mgr is not None and idx % cfg.save_state_every_n_steps == 0:
+                return False
+            if val_data is not None and idx % cfg.val_check_interval == 0:
+                return False
+        if cfg.profile_start is not None and start + k > cfg.profile_start:
+            # singles from just before the capture window until past it
+            if start <= cfg.profile_start + _PROFILE_WINDOW - 1:
+                return False
+        return True
+
     def _fit_loop(
         self, cfg, train_step, rng, next_batch, val_data, resume_mgr, start_step
     ) -> None:
         window: list = []
         profiling = False
         t0 = time.time()
+        k_exec = cfg.steps_per_execution
+        multi_step = None
+        if k_exec > 1:
+            multi_step = make_train_step(
+                self.loss_fn,
+                self.mesh,
+                self._shardings,
+                grad_clip_norm=cfg.grad_clip_norm,
+                grad_accum_steps=cfg.grad_accum_steps,
+                multi_steps=k_exec,
+            )
         with self.mesh:
-            for step_idx in range(start_step, cfg.max_steps + 1):
-                batch = next_batch()
-                # fold_in (not sequential split): step k's rng is a pure
-                # function of (seed, k), so a resumed run replays the
-                # identical dropout/augmentation stream
-                step_rng = jax.random.fold_in(rng, step_idx)
-                batch = shard_or_assemble(batch, self.mesh, shard_seq=cfg.shard_seq)
-                if cfg.profile_start is not None and step_idx == cfg.profile_start:
-                    jax.profiler.start_trace(
-                        os.path.join(cfg.default_root_dir, "profile")
+            step_idx = start_step
+            while step_idx <= cfg.max_steps:
+                if multi_step is not None and self._block_ok(
+                    cfg, step_idx, k_exec, val_data, resume_mgr
+                ):
+                    # one device program for k_exec steps (amortized dispatch)
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *[next_batch() for _ in range(k_exec)]
                     )
-                    profiling = True
-                self.state, metrics = train_step(self.state, batch, step_rng)
-                window.append(metrics)
-                if profiling and step_idx >= cfg.profile_start + 2:
-                    jax.block_until_ready(metrics["loss"])
-                    jax.profiler.stop_trace()
-                    profiling = False
+                    stacked = shard_or_assemble(
+                        stacked, self.mesh, shard_seq=cfg.shard_seq, stacked_steps=True
+                    )
+                    rngs = jnp.stack(
+                        [jax.random.fold_in(rng, step_idx + i) for i in range(k_exec)]
+                    )
+                    self.state, stacked_metrics = multi_step(self.state, stacked, rngs)
+                    per_step = [
+                        {k: v[i] for k, v in stacked_metrics.items()}
+                        for i in range(k_exec)
+                    ]
+                    n_ran = k_exec
+                else:
+                    batch = next_batch()
+                    # fold_in (not sequential split): step k's rng is a pure
+                    # function of (seed, k), so a resumed run replays the
+                    # identical dropout/augmentation stream
+                    step_rng = jax.random.fold_in(rng, step_idx)
+                    batch = shard_or_assemble(
+                        batch, self.mesh, shard_seq=cfg.shard_seq
+                    )
+                    if cfg.profile_start is not None and step_idx == cfg.profile_start:
+                        jax.profiler.start_trace(
+                            os.path.join(cfg.default_root_dir, "profile")
+                        )
+                        profiling = True
+                    self.state, metrics = train_step(self.state, batch, step_rng)
+                    per_step = [metrics]
+                    n_ran = 1
+                    if profiling and step_idx >= cfg.profile_start + _PROFILE_WINDOW - 1:
+                        jax.block_until_ready(metrics["loss"])
+                        jax.profiler.stop_trace()
+                        profiling = False
+
+                for m in per_step:
+                    window.append(m)
+                step_idx += n_ran - 1  # bookkeeping below runs at the block's last step
 
                 def flush_window(step_idx=step_idx):
                     nonlocal window, t0
@@ -339,7 +405,10 @@ class Trainer:
                             "snapshot with a lower lr / grad clip"
                         )
 
-                if step_idx % cfg.log_every_n_steps == 0:
+                if (
+                    step_idx % cfg.log_every_n_steps < n_ran
+                    and step_idx >= cfg.log_every_n_steps
+                ):
                     flush_window()
 
                 if resume_mgr is not None and (
@@ -378,6 +447,7 @@ class Trainer:
                         if self.is_main_process:
                             cb(self, self.state, step_idx, val_metrics)
                     t0 = time.time()
+                step_idx += 1
             if profiling:  # max_steps ended inside the capture window
                 jax.profiler.stop_trace()
 
