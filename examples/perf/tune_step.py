@@ -85,6 +85,9 @@ def run_one(args_list, env_extra, timeout_s):
     # Start from an env with every PERCEIVER_FLASH_* knob stripped: configs
     # must see exactly the knobs they declare, not leftovers from the shell.
     env = {k: v for k, v in os.environ.items() if not k.startswith("PERCEIVER_FLASH_")}
+    # shared XLA disk cache: identical programs across sweep configs (e.g.
+    # the xla attention path under different env knobs) compile once
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/perceiver_xla_cache")
     env.update(env_extra)
     t0 = time.monotonic()
     try:

@@ -106,20 +106,22 @@ def test_trainer_steps_per_execution_matches_single(tmp_path):
     val = _batches(2, seed=99)
 
     finals = {}
+    log_steps = {}
     for k_exec in (1, 4):
         mesh = make_mesh(MeshConfig(data=2))
+        root = tmp_path / f"k{k_exec}"
         trainer = Trainer(
             TrainerConfig(
                 max_steps=10,
                 steps_per_execution=k_exec,
-                # val at 3, 6, 9 is NOT divisible by k_exec=4, so blocks must
-                # be rejected mid-stream and the single/block interleave (and
-                # _block_ok's interior-step rejection) is actually exercised
-                val_check_interval=3,
+                # val at 5 and 10: blocks run at [1-4] and [6-9], while steps
+                # 5 and 10 are forced single by _block_ok — both the fused
+                # path and the boundary rejection are exercised
+                val_check_interval=5,
                 log_every_n_steps=2,
                 enable_checkpointing=False,
                 enable_tensorboard=False,
-                default_root_dir=str(tmp_path / f"k{k_exec}"),
+                default_root_dir=str(root),
             ),
             mesh,
             clm_loss_fn(model, LATENTS),
@@ -128,7 +130,15 @@ def test_trainer_steps_per_execution_matches_single(tmp_path):
         state = trainer.fit(init, iter(_batches(10)), val_data=lambda: iter(val))
         assert int(jax.device_get(state.step)) == 10
         finals[k_exec] = jax.device_get(state.params)
+        import json
 
+        rows = [json.loads(l) for l in open(root / "metrics.jsonl")]
+        log_steps[k_exec] = [r["step"] for r in rows if "train/loss" in r]
+
+    # the flush signature proves blocks actually executed: single-step runs
+    # flush on every multiple of 2, the blocked run flushes at block ends
+    assert log_steps[1] == [2, 4, 5, 6, 8, 10], log_steps[1]
+    assert log_steps[4] == [4, 5, 9, 10], log_steps[4]
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
         finals[1], finals[4],
