@@ -235,7 +235,7 @@ def _build_ar(cfg, mesh, impl):
 
     state, shardings = create_train_state(init, optax.adamw(3e-4), mesh)
     step = make_train_step(clm_loss_fn(model, cfg.max_latents), mesh, shardings)
-    return model, state, step
+    return model, state, step, shardings
 
 
 def _time_train(step, state, sharded, key, *, n_chain: int, n_sync: int):
@@ -304,7 +304,7 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
         n_chain = 20 if platform == "tpu" else 3
         log("run: building AR train step (flash/auto)")
         try:
-            model, state, step = _build_ar(cfg, mesh, "auto")
+            model, state, step, shardings = _build_ar(cfg, mesh, "auto")
             chained_ms, synced_ms, state, loss = _time_train(
                 step, state, sharded, key, n_chain=n_chain, n_sync=4
             )
@@ -312,7 +312,7 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
             log(f"run: flash path failed ({type(e).__name__}: {e}); retrying with xla")
             impl_used = "xla"
             model = state = step = None  # free the failed build's device memory
-            model, state, step = _build_ar(cfg, mesh, "xla")
+            model, state, step, shardings = _build_ar(cfg, mesh, "xla")
             chained_ms, synced_ms, state, loss = _time_train(
                 step, state, sharded, key, n_chain=n_chain, n_sync=4
             )
@@ -356,39 +356,30 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
         # of the loop — the deployment-mode number for long training runs.
         if platform == "tpu" and left() > 150.0:
             log("run: fused 10-step block")
+            fstate = fused = stacked = None
             try:
-                from perceiver_io_tpu.parallel import create_train_state, make_train_step
+                from perceiver_io_tpu.parallel import make_train_step
                 from perceiver_io_tpu.training.tasks import clm_loss_fn
-                import optax
 
                 K = 10
-                fstate, fshard = create_train_state(
-                    lambda: model.init(
-                        jax.random.PRNGKey(0),
-                        jnp.zeros((1, cfg.max_seq_len), jnp.int32),
-                        cfg.max_seq_len - cfg.max_latents,
-                    )["params"],
-                    optax.adamw(3e-4),
-                    mesh,
-                )
+                # donate=False: reuses the live primary state without
+                # consuming it (the cross-check/decode stages still need it)
                 fused = make_train_step(
-                    clm_loss_fn(model, cfg.max_latents), mesh, fshard, multi_steps=K
+                    clm_loss_fn(model, cfg.max_latents), mesh, shardings,
+                    multi_steps=K, donate=False,
                 )
-                from perceiver_io_tpu.parallel import shard_batch as _sb
-
                 stk = {
                     k2: np.broadcast_to(np.asarray(v)[None], (K, *np.shape(v))).copy()
                     for k2, v in batch.items()
                 }
-                stacked = _sb(stk, mesh, stacked_steps=True)
+                stacked = shard_batch(stk, mesh, stacked_steps=True)
                 keys = jax.random.split(jax.random.PRNGKey(3), K)
-                fstate, fm = fused(fstate, stacked, keys)  # compile + warm
+                fstate, fm = fused(state, stacked, keys)  # compile + warm
                 _fetch(fm["loss"][-1])
                 t0 = time.perf_counter()
-                fstate, fm = fused(fstate, stacked, keys)
+                fstate, fm = fused(state, stacked, keys)
                 _fetch(fm["loss"][-1])
                 fused_ms = (time.perf_counter() - t0) / K * 1e3
-                fstate = None  # free before the next stage
                 res.update(extras={**res.data["extras"], "fused_multi_step": {
                     "per_step_ms": round(fused_ms, 2),
                     "tokens_per_sec": round(
@@ -400,6 +391,8 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 log(f"run: fused block failed ({type(e).__name__}: {e})")
                 res.update(extras={**res.data["extras"], "fused_multi_step": {
                     "error": f"{type(e).__name__}: {e}"}})
+            finally:
+                fstate = fused = stacked = None  # release HBM for later stages
 
         # ---- extra: practical matmul ceiling (contextualizes MFU) ----
         if platform == "tpu" and left() > 150.0:
@@ -615,7 +608,10 @@ def _spawn(args, timeout, env_extra=None):
     env = dict(os.environ)
     # Persistent XLA compilation cache: re-runs (and the retry/fallback
     # stages) skip the 20-40s first-compile of unchanged programs.
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/perceiver_xla_cache")
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), f"perceiver_xla_cache_{os.getuid()}"),
+    )
     if env_extra:
         env.update(env_extra)
     try:

@@ -27,6 +27,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -65,7 +66,7 @@ def child(shape, impl: str) -> None:
     ids = rng.integers(0, cfg.vocab_size, size=(batch_size, cfg.max_seq_len + 1), dtype=np.int32)
     with mesh:
         sharded = shard_batch({"input_ids": ids[:, :-1], "labels": ids[:, 1:]}, mesh)
-        _, state, step = bench._build_ar(cfg, mesh, impl)
+        _, state, step, _ = bench._build_ar(cfg, mesh, impl)
         chained_ms, synced_ms, _, loss = bench._time_train(
             step, state, sharded, jax.random.PRNGKey(1), n_chain=20, n_sync=2
         )
@@ -87,7 +88,10 @@ def run_one(args_list, env_extra, timeout_s):
     env = {k: v for k, v in os.environ.items() if not k.startswith("PERCEIVER_FLASH_")}
     # shared XLA disk cache: identical programs across sweep configs (e.g.
     # the xla attention path under different env knobs) compile once
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/perceiver_xla_cache")
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), f"perceiver_xla_cache_{os.getuid()}"),
+    )
     env.update(env_extra)
     t0 = time.monotonic()
     try:
