@@ -18,6 +18,7 @@ from perceiver_io_tpu.inference.pipelines import (
     TextClassificationPipeline,
     TextGenerationPipeline,
     pipeline,
+    cast_float_params,
     pipeline_from_pretrained,
 )
 
@@ -29,6 +30,7 @@ __all__ = [
     "beam_search",
     "MaskFiller",
     "pipeline",
+    "cast_float_params",
     "pipeline_from_pretrained",
     "TextGenerationPipeline",
     "FillMaskPipeline",

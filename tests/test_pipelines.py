@@ -231,3 +231,35 @@ def test_pipeline_from_pretrained_round_trip(tiny_clm, tmp_path):
     a = pipe("hello", max_new_tokens=4, num_latents=4, temperature=0.0)
     b = direct("hello", max_new_tokens=4, num_latents=4, temperature=0.0)
     assert a == b
+
+
+def test_bf16_param_storage(tiny_clm, tmp_path):
+    """cast_float_params: float leaves become bf16 (int leaves untouched),
+    the model still runs, and logits stay close to the fp32-weight path —
+    the decode-loop weight-traffic optimization (docs/parallelism.md)."""
+    from perceiver_io_tpu.inference import cast_float_params, pipeline_from_pretrained
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    model, params = tiny_clm
+    cast = cast_float_params(params, jnp.bfloat16)
+    leaves = jax.tree_util.tree_leaves(cast)
+    assert all(
+        l.dtype == jnp.bfloat16 for l in leaves
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(1, 262, (2, 32)), jnp.int32)
+    logits32 = model.apply({"params": params}, ids, 16)
+    logits16 = model.apply({"params": cast}, ids, 16).astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(logits16), np.asarray(logits32), atol=5e-2, rtol=5e-2
+    )
+
+    # end-to-end through the pretrained loader
+    save_pretrained(str(tmp_path / "m16"), params, model.config)
+    pipe = pipeline_from_pretrained(
+        "text-generation", str(tmp_path / "m16"), ByteTokenizer(padding_side="left"),
+        params_dtype=jnp.bfloat16,
+    )
+    out = pipe("hello", max_new_tokens=4, num_latents=4, temperature=0.0)
+    assert len(out) == 1 and out[0].startswith("hello")
