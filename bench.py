@@ -569,11 +569,16 @@ def _bench_mlm(mesh, platform: str):
 
 def _bench_decode(model, params, cfg):
     """Cached vs windowed-recompute decode tokens/s at the 8k-ctx shape —
-    the KV cache's reason to exist (VERDICT r2 ask #4a)."""
+    the KV cache's reason to exist (VERDICT r2 ask #4a). Weights are stored
+    bf16 (cast_float_params): the deployment config — decode is HBM-bandwidth
+    bound at small batch, and fp32 weight reads would double that traffic."""
     import jax.numpy as jnp
     import numpy as np
 
+    from perceiver_io_tpu.inference import cast_float_params
     from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+
+    params = cast_float_params(params, jnp.bfloat16)
 
     b, new_tokens = 4, 32
     prompt_len = cfg.max_seq_len // 2  # latent-growth + prefix-growth phases

@@ -345,6 +345,10 @@ def pipeline_from_pretrained(task: str, path: str, *args, dtype=None,
     :param params_dtype: storage dtype for the loaded weights — pass
         ``jnp.bfloat16`` to halve decode-loop weight traffic
         (:func:`cast_float_params`); ``None`` keeps the checkpoint's dtype.
+        The cast happens after a full-precision restore, so load-time peak
+        host memory is ~1.5× the fp32 tree (~2 GB for the largest reference
+        model); restore-into-dtype via ``load_pretrained(target=...)`` is the
+        escape hatch if that ever matters.
     """
     from perceiver_io_tpu.models import model_for_config
     from perceiver_io_tpu.training.checkpoint import load_pretrained
