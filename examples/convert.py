@@ -28,6 +28,22 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
+
+# runnable without `pip install -e .`: python examples/convert.py ...
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _force_cpu() -> None:
+    """Conversion is a host-side param transform — never claim an
+    accelerator for it (and never hang if one is configured but
+    unreachable). Must run after importing jax, before its first use;
+    the JAX_PLATFORMS env var alone is not enough on hosts whose
+    sitecustomize force-registers an accelerator plugin."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _load_state_dict(path: str):
@@ -40,6 +56,11 @@ def _load_state_dict(path: str):
     sd = torch.load(path, map_location="cpu", weights_only=True)
     if "state_dict" in sd:  # Lightning checkpoint wrapper
         sd = sd["state_dict"]
+    # Reference Lit* wrappers hold the backend as ``self.model`` (reference
+    # ``clm/lightning.py:41``), so real .ckpt keys carry a uniform "model."
+    # prefix the backend importers don't expect — strip it.
+    if sd and all(k.startswith("model.") for k in sd):
+        sd = {k[len("model."):]: v for k, v in sd.items()}
     return sd
 
 
@@ -74,6 +95,7 @@ def _mlm_config(args):
 
 
 def main() -> None:
+    _force_cpu()
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )

@@ -10,6 +10,19 @@ from __future__ import annotations
 from typing import Any
 
 
+def import_task_modules() -> None:
+    """Import every task-model module — the single canonical list. Importing
+    a module registers its config dataclasses (``register_config``), so this
+    is what makes bare checkpoint loading (``load_pretrained`` before any
+    model import) able to rebuild configs."""
+    import perceiver_io_tpu.models.audio.symbolic  # noqa: F401
+    import perceiver_io_tpu.models.text.classifier  # noqa: F401
+    import perceiver_io_tpu.models.text.clm  # noqa: F401
+    import perceiver_io_tpu.models.text.mlm  # noqa: F401
+    import perceiver_io_tpu.models.vision.image_classifier  # noqa: F401
+    import perceiver_io_tpu.models.vision.optical_flow  # noqa: F401
+
+
 def model_for_config(config: Any, *, dtype=None, attention_impl: str = "auto"):
     """Instantiate the task model matching a (nested) config dataclass."""
     import jax.numpy as jnp
@@ -46,4 +59,4 @@ def model_for_config(config: Any, *, dtype=None, attention_impl: str = "auto"):
     raise ValueError(f"no model registered for config {type(config).__name__}")
 
 
-__all__ = ["model_for_config"]
+__all__ = ["import_task_modules", "model_for_config"]

@@ -141,9 +141,19 @@ def config_from_dict(cls, d: Dict[str, Any]):
     ``cls`` is the expected (base) class; an embedded ``"_type"`` naming a
     registered subclass takes precedence.
     """
-    target = _CONFIG_REGISTRY.get(d.get("_type", ""), cls)
+    type_name = d.get("_type", "")
+    if type_name and type_name not in _CONFIG_REGISTRY:
+        # Task configs register at their module's import; a checkpoint can be
+        # loaded before any model module was touched (e.g. bare
+        # ``load_pretrained``) — pull them in once, then retry. This must run
+        # even when a fallback ``cls`` is supplied: a stale fallback would
+        # silently rebuild the wrong (base) dataclass.
+        from perceiver_io_tpu.models import import_task_modules
+
+        import_task_modules()
+    target = _CONFIG_REGISTRY.get(type_name, cls)
     if target is None:
-        raise ValueError(f"unknown config type {d.get('_type')!r} (not registered)")
+        raise ValueError(f"unknown config type {type_name!r} (not registered)")
     kwargs = {}
     for f in fields(target):
         if f.name not in d:
