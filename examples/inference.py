@@ -6,7 +6,11 @@ Run individual demos:  python examples/inference.py text-generation logs/clm/exp
 """
 from __future__ import annotations
 
+import os
 import sys
+
+# runnable without `pip install -e .`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -12,6 +12,12 @@ Usage::
 """
 from __future__ import annotations
 
+import os
+import sys
+
+# runnable without `pip install -e .`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
 import argparse
 import csv
 

@@ -19,9 +19,14 @@ Example (tiny CPU smoke sweep)::
 """
 from __future__ import annotations
 
+import os
+import sys
+
+# runnable without `pip install -e .`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
 import argparse
 import csv
-import os
 import time
 
 import numpy as np

@@ -11,10 +11,12 @@ from typing import Any
 
 
 def import_task_modules() -> None:
-    """Import every task-model module — the single canonical list. Importing
-    a module registers its config dataclasses (``register_config``), so this
-    is what makes bare checkpoint loading (``load_pretrained`` before any
-    model import) able to rebuild configs."""
+    """Import every task-model module — the canonical registration point.
+    Importing a module registers its config dataclasses (``register_config``),
+    so this is what makes bare checkpoint loading (``load_pretrained`` before
+    any model import) able to rebuild configs. ``model_for_config`` routes
+    through here too; a new task model only needs adding to this list (its
+    dispatch entry below will then fail loudly in tests if forgotten)."""
     import perceiver_io_tpu.models.audio.symbolic  # noqa: F401
     import perceiver_io_tpu.models.text.classifier  # noqa: F401
     import perceiver_io_tpu.models.text.clm  # noqa: F401
@@ -26,6 +28,8 @@ def import_task_modules() -> None:
 def model_for_config(config: Any, *, dtype=None, attention_impl: str = "auto"):
     """Instantiate the task model matching a (nested) config dataclass."""
     import jax.numpy as jnp
+
+    import_task_modules()
 
     from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
     from perceiver_io_tpu.models.core.config import (
