@@ -19,6 +19,7 @@ from perceiver_io_tpu.data.audio.symbolic import (
     MaestroV3DataModule,
     SymbolicAudioCollator,
     SymbolicAudioDataModule,
+    SyntheticSymbolicAudioDataModule,
     SymbolicAudioDataset,
 )
 
@@ -35,6 +36,7 @@ __all__ = [
     "decode_to_midi_file",
     "SymbolicAudioCollator",
     "SymbolicAudioDataModule",
+    "SyntheticSymbolicAudioDataModule",
     "SymbolicAudioDataset",
     "MaestroV3DataModule",
     "GiantMidiPianoDataModule",

@@ -9,6 +9,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from perceiver_io_tpu.data.text.datamodule import Task, TextDataModule
 
 
@@ -150,3 +152,80 @@ class WikipediaDataModule(_HubDataModule):
         texts = self._texts(ds)
         n_valid = int(len(texts) * self.source_valid_size)
         return {"train": texts[: len(texts) - n_valid], "valid": texts[len(texts) - n_valid :]}
+
+
+class SyntheticTextDataModule(ListDataModule):
+    """Deterministic synthetic corpus — offline smoke runs, CI, and config
+    dry-runs (no reference counterpart: the reference cannot train without
+    downloading a dataset).
+
+    For mlm/clm, documents are order-1 Markov character text over a seeded
+    transition matrix: structured (entropy well below uniform) so a model
+    can visibly learn, yet fully reproducible. For the clf task, each
+    document samples words from one of two disjoint pools and the label is
+    the pool index — linearly separable, so accuracy climbs within a few
+    steps. Generation happens lazily in :meth:`load_source_dataset` (cache
+    misses only), and the generation parameters are part of the preproc
+    cache key — changing them regenerates instead of reusing stale arrays.
+    """
+
+    _ALPHABET = "abcdefghijklmnopqrstuvwxyz "
+
+    def __init__(
+        self,
+        dataset_dir: str = ".cache/synthetic",
+        num_train_docs: int = 64,
+        num_valid_docs: int = 16,
+        doc_chars: int = 2048,
+        corpus_seed: int = 0,
+        **kwargs,
+    ):
+        self.num_train_docs = num_train_docs
+        self.num_valid_docs = num_valid_docs
+        self.doc_chars = doc_chars
+        self.corpus_seed = corpus_seed
+        task = kwargs.get("task", "mlm")
+        self._clf = (task if isinstance(task, str) else getattr(task, "name", "mlm")) == "clf"
+        super(ListDataModule, self).__init__(dataset_dir=dataset_dir, **kwargs)
+        self._num_classes = 2 if self._clf else None
+
+    def preproc_dir_hash_input(self) -> str:
+        return (
+            super().preproc_dir_hash_input()
+            + f"|synthetic:{self.num_train_docs},{self.num_valid_docs},"
+            + f"{self.doc_chars},{self.corpus_seed}"
+        )
+
+    def load_source_dataset(self) -> Dict[str, object]:
+        rng = np.random.default_rng(self.corpus_seed)
+        if self._clf:
+            pools = (
+                ["alpha", "bravo", "carbon", "delta", "ember"],
+                ["zinc", "yarrow", "xenon", "willow", "vortex"],
+            )
+
+            def split(n):
+                labels = [int(i % 2) for i in range(n)]
+                texts = [
+                    " ".join(rng.choice(pools[l], size=max(1, self.doc_chars // 8)))
+                    for l in labels
+                ]
+                return {"text": texts, "label": labels}
+
+            return {"train": split(self.num_train_docs), "valid": split(self.num_valid_docs)}
+
+        k = len(self._ALPHABET)
+        trans = rng.dirichlet(np.full(k, 0.3), size=k)  # peaked rows
+
+        def doc():
+            states = np.empty(self.doc_chars, np.int64)
+            s = int(rng.integers(k))
+            for i in range(self.doc_chars):
+                s = int(rng.choice(k, p=trans[s]))
+                states[i] = s
+            return "".join(self._ALPHABET[c] for c in states)
+
+        return {
+            "train": [doc() for _ in range(self.num_train_docs)],
+            "valid": [doc() for _ in range(self.num_valid_docs)],
+        }

@@ -6,6 +6,7 @@ flow patch/blend/render processor feeding the optical-flow pipeline.
 from perceiver_io_tpu.data.vision.image import (
     ImagePreprocessor,
     MNISTDataModule,
+    SyntheticImageDataModule,
     random_crop_and_flip,
 )
 from perceiver_io_tpu.data.vision.imagenet import ImageNetPreprocessor, resize_bilinear
@@ -19,6 +20,7 @@ __all__ = [
     "ImageNetPreprocessor",
     "resize_bilinear",
     "MNISTDataModule",
+    "SyntheticImageDataModule",
     "random_crop_and_flip",
     "OpticalFlowProcessor",
     "render_optical_flow",

@@ -11,6 +11,7 @@ from perceiver_io_tpu.data.audio import (
     GiantMidiPianoDataModule,
     MaestroV3DataModule,
     SymbolicAudioDataModule,
+    SyntheticSymbolicAudioDataModule,
 )
 from perceiver_io_tpu.models.audio.symbolic import (
     SymbolicAudioModel,
@@ -20,6 +21,7 @@ from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
 from perceiver_io_tpu.training.tasks import clm_loss_fn
 
 DATA = {
+    "synthetic": SyntheticSymbolicAudioDataModule,
     "maestro": MaestroV3DataModule,
     "giantmidi": GiantMidiPianoDataModule,
     "symbolic": SymbolicAudioDataModule,

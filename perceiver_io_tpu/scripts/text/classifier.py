@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from perceiver_io_tpu.data.text.sources import ImdbDataModule, ListDataModule
+from perceiver_io_tpu.data.text.sources import ImdbDataModule, ListDataModule, SyntheticTextDataModule
 from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifierConfig
 from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
 from perceiver_io_tpu.models.text.common import TextEncoderConfig
@@ -18,6 +18,7 @@ from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
 from perceiver_io_tpu.training.tasks import classifier_loss_fn
 
 DATA = {
+    "synthetic": SyntheticTextDataModule,
     "imdb": ImdbDataModule,
     "list": ListDataModule,
 }

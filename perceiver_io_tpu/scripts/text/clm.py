@@ -13,6 +13,7 @@ from perceiver_io_tpu.data.text.sources import (
     ListDataModule,
     WikipediaDataModule,
     WikiTextDataModule,
+    SyntheticTextDataModule,
 )
 from perceiver_io_tpu.data.text.streaming import C4DataModule
 from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
@@ -20,6 +21,7 @@ from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
 from perceiver_io_tpu.training.tasks import clm_loss_fn
 
 DATA = {
+    "synthetic": SyntheticTextDataModule,
     "wikitext": WikiTextDataModule,
     "enwik8": Enwik8DataModule,
     "bookcorpus": BookCorpusDataModule,

@@ -9,6 +9,7 @@ from perceiver_io_tpu.data.text.sources import (
     ListDataModule,
     WikipediaDataModule,
     WikiTextDataModule,
+    SyntheticTextDataModule,
 )
 from perceiver_io_tpu.models.text.common import TextEncoderConfig
 from perceiver_io_tpu.models.text.mlm import (
@@ -20,6 +21,7 @@ from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
 from perceiver_io_tpu.training.tasks import mlm_loss_fn
 
 DATA = {
+    "synthetic": SyntheticTextDataModule,
     "wikitext": WikiTextDataModule,
     "imdb": ImdbDataModule,
     "bookcorpus": BookCorpusDataModule,

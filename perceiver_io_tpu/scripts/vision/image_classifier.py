@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from perceiver_io_tpu.data.vision import MNISTDataModule
+from perceiver_io_tpu.data.vision import MNISTDataModule, SyntheticImageDataModule
 from perceiver_io_tpu.models.core.config import ClassificationDecoderConfig
 from perceiver_io_tpu.models.vision.image_classifier import (
     ImageClassifier,
@@ -17,7 +17,7 @@ from perceiver_io_tpu.models.vision.image_classifier import (
 from perceiver_io_tpu.scripts.cli import CLI, ModelFamily
 from perceiver_io_tpu.training.tasks import image_classifier_loss_fn
 
-DATA = {"mnist": MNISTDataModule}
+DATA = {"mnist": MNISTDataModule, "synthetic": SyntheticImageDataModule}
 
 
 def _link(dm, values):

@@ -1,0 +1,116 @@
+"""Every model family's CLI, end to end on the built-in ``synthetic``
+source: fit a few steps and validate, entirely offline. Closes the gap where
+only the CLM family's CLI had in-process coverage; the synthetic datamodules
+are product surface (`--data=synthetic` config dry-runs), not test fixtures.
+"""
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.scripts.cli import CLI
+
+COMMON = [
+    "--data=synthetic",
+    "--optimizer.lr=1e-3",
+    "--trainer.max_steps=3",
+    "--trainer.val_check_interval=3",
+    "--trainer.log_every_n_steps=2",
+    "--trainer.enable_checkpointing=false",
+    "--trainer.enable_tensorboard=false",
+]
+
+
+def _run(family, argv, tmp_path):
+    argv = argv + COMMON + [f"--trainer.default_root_dir={tmp_path}/logs"]
+    state = CLI(family).main(["fit", *argv])
+    assert state is not None and int(state.step) == 3
+    metrics = CLI(family).main(["validate", *argv])
+    assert "loss" in metrics and np.isfinite(metrics["loss"])
+    return metrics
+
+
+@pytest.mark.slow
+def test_image_classifier_cli_synthetic(tmp_path):
+    from perceiver_io_tpu.scripts.vision.image_classifier import FAMILY
+
+    metrics = _run(
+        FAMILY,
+        [
+            "--data.batch_size=8",
+            "--data.num_train=32",
+            "--data.num_valid=16",
+            "--model.num_latents=8",
+            "--model.num_latent_channels=32",
+            "--model.encoder.num_frequency_bands=8",
+            "--model.encoder.num_cross_attention_heads=1",
+            "--model.decoder.num_output_query_channels=32",
+            "--model.decoder.num_cross_attention_heads=2",
+        ],
+        tmp_path,
+    )
+    assert "accuracy" in metrics
+
+
+@pytest.mark.slow
+def test_symbolic_audio_cli_synthetic(tmp_path):
+    from perceiver_io_tpu.scripts.audio.symbolic import FAMILY
+
+    _run(
+        FAMILY,
+        [
+            "--data.max_seq_len=64",
+            "--data.batch_size=8",
+            "--data.num_train_pieces=4",
+            "--data.num_valid_pieces=4",
+            "--data.mean_piece_len=512",
+            "--model.max_latents=32",
+            "--model.num_channels=32",
+            "--model.num_heads=2",
+            "--model.num_self_attention_layers=1",
+            "--model.cross_attention_dropout=0.0",
+        ],
+        tmp_path,
+    )
+
+
+@pytest.mark.slow
+def test_mlm_cli_synthetic(tmp_path):
+    from perceiver_io_tpu.scripts.text.mlm import FAMILY
+
+    _run(
+        FAMILY,
+        [
+            f"--data.dataset_dir={tmp_path}/data",
+            "--data.max_seq_len=64",
+            "--data.batch_size=8",
+            "--data.num_train_docs=8",
+            "--data.num_valid_docs=8",
+            "--data.doc_chars=256",
+            "--model.encoder.num_input_channels=32",
+            "--model.num_latents=16",
+            "--model.num_latent_channels=32",
+        ],
+        tmp_path,
+    )
+
+
+@pytest.mark.slow
+def test_text_classifier_cli_synthetic(tmp_path):
+    from perceiver_io_tpu.scripts.text.classifier import FAMILY
+
+    metrics = _run(
+        FAMILY,
+        [
+            f"--data.dataset_dir={tmp_path}/data",
+            "--data.task=clf",
+            "--data.max_seq_len=64",
+            "--data.batch_size=8",
+            "--data.num_train_docs=8",
+            "--data.num_valid_docs=16",
+            "--data.doc_chars=128",
+            "--model.encoder.num_input_channels=32",
+            "--model.num_latents=16",
+            "--model.num_latent_channels=32",
+        ],
+        tmp_path,
+    )
+    assert "accuracy" in metrics
