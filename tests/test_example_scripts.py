@@ -1,0 +1,38 @@
+"""The example training scripts must stay flag-valid: every ``--x=y`` in
+``examples/training/*.sh`` has to exist in its CLI's generated flag space
+(catches drift between the dataclass configs and the documented commands)."""
+import importlib
+import re
+from pathlib import Path
+
+import pytest
+
+from perceiver_io_tpu.scripts.cli import CLI
+
+REPO = Path(__file__).resolve().parents[1]
+
+SCRIPTS = {
+    "clm.sh": "perceiver_io_tpu.scripts.text.clm",
+    "mlm.sh": "perceiver_io_tpu.scripts.text.mlm",
+    "sam.sh": "perceiver_io_tpu.scripts.audio.symbolic",
+    "img_clf.sh": "perceiver_io_tpu.scripts.vision.image_classifier",
+    "txt_clf.sh": "perceiver_io_tpu.scripts.text.classifier",
+}
+
+
+@pytest.mark.parametrize("script,module", sorted(SCRIPTS.items()))
+def test_example_script_flags_are_known(script, module):
+    text = (REPO / "examples" / "training" / script).read_text()
+    family = importlib.import_module(module).FAMILY
+    data_m = re.search(r"--data[= ](\w+)", text)
+    assert data_m, f"{script} must select a data source with --data=<name>"
+    data_name = data_m.group(1)
+    assert data_name in family.data_registry, f"unknown data source {data_name!r}"
+    known = CLI(family)._known_flags(family.data_registry[data_name])
+    flags = [f for f in re.findall(r"--([\w.]+)=", text) if f != "data"]
+    unknown = [f for f in flags if f not in known]
+    assert not unknown, f"{script} uses unknown flags {unknown}"
+    # the documented command must actually invoke the fit subcommand
+    assert re.search(rf"-m {re.escape(module)} fit\b", text), (
+        f"{script} must invoke `python -m {module} fit`"
+    )
