@@ -29,8 +29,10 @@ def test_example_script_flags_are_known(script, module):
     data_name = data_m.group(1)
     assert data_name in family.data_registry, f"unknown data source {data_name!r}"
     known = CLI(family)._known_flags(family.data_registry[data_name])
-    flags = [f for f in re.findall(r"--([\w.]+)=", text) if f != "data"]
-    unknown = [f for f in flags if f not in known]
+    # every --token, space- or =-separated, must be a known flag (the CLI
+    # accepts both forms; a typo'd flag in either must fail here)
+    flags = [t.split("=", 1)[0] for t in re.findall(r"--(\S+)", text)]
+    unknown = [f for f in flags if f != "data" and f not in known]
     assert not unknown, f"{script} uses unknown flags {unknown}"
     # the documented command must actually invoke the fit subcommand
     assert re.search(rf"-m {re.escape(module)} fit\b", text), (

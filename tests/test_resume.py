@@ -33,7 +33,7 @@ def _batches(n):
     return out
 
 
-def _fit(root, max_steps, *, save_every=None, resume=None):
+def _fit(root, max_steps, *, save_every=None, resume=None, steps_per_execution=1):
     model, cfg = _model()
     mesh = make_mesh(MeshConfig(data=1))
     trainer = Trainer(
@@ -47,6 +47,7 @@ def _fit(root, max_steps, *, save_every=None, resume=None):
             seed=7,
             save_state_every_n_steps=save_every,
             resume=resume,
+            steps_per_execution=steps_per_execution,
         ),
         mesh,
         clm_loss_fn(model, LATENTS),
@@ -66,8 +67,24 @@ def _fit(root, max_steps, *, save_every=None, resume=None):
     return state
 
 
-def test_kill_and_resume_matches_uninterrupted(tmp_path):
-    straight = _fit(tmp_path / "straight", 9)
+@pytest.fixture(scope="module")
+def straight_9(tmp_path_factory):
+    """Deterministic uninterrupted 9-step baseline shared by the resume
+    equivalence tests (seed, data, and rng stream are all fixed)."""
+    return _fit(tmp_path_factory.mktemp("straight"), 9)
+
+
+def _assert_states_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                    jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
+    for x, y in zip(jax.tree_util.tree_leaves(a.opt_state),
+                    jax.tree_util.tree_leaves(b.opt_state)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
+
+
+def test_kill_and_resume_matches_uninterrupted(tmp_path, straight_9):
+    straight = straight_9
 
     _fit(tmp_path / "killed", 5, save_every=5)  # "dies" after step 5
     resumed = _fit(
@@ -75,16 +92,21 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path):
     )
 
     assert int(resumed.step) == int(straight.step) == 9
-    flat_a = jax.tree_util.tree_leaves(straight.params)
-    flat_b = jax.tree_util.tree_leaves(resumed.params)
-    for a, b in zip(flat_a, flat_b):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
-    # optimizer state (incl. adam moments / schedule count) must match too
-    for a, b in zip(
-        jax.tree_util.tree_leaves(straight.opt_state),
-        jax.tree_util.tree_leaves(resumed.opt_state),
-    ):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+    _assert_states_equal(straight, resumed)
+
+
+def test_kill_and_resume_with_fused_blocks_matches(tmp_path, straight_9):
+    """Resume composes with steps_per_execution: a run killed at a snapshot
+    and resumed with fused 3-step blocks must replay the identical
+    trajectory (same fold_in rngs, same data order through the blocks)."""
+    _fit(tmp_path / "killed", 5, save_every=5, steps_per_execution=3)
+    resumed = _fit(
+        tmp_path / "killed", 9, save_every=5,
+        resume=str(tmp_path / "killed"), steps_per_execution=3,
+    )
+
+    assert int(resumed.step) == int(straight_9.step) == 9
+    _assert_states_equal(straight_9, resumed)
 
 
 def test_resume_manager_round_trip(tmp_path):
