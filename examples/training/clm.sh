@@ -13,6 +13,7 @@ python -m perceiver_io_tpu.scripts.text.clm fit \
   --model.num_self_attention_layers=8 \
   --model.cross_attention_dropout=0.5 \
   --trainer.grad_accum_steps=4 \
+  --trainer.steps_per_execution=8 \
   --optimizer.lr=2e-4 \
   --lr_scheduler.warmup_steps=200 \
   --trainer.max_steps=25000 \

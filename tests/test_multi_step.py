@@ -143,3 +143,39 @@ def test_trainer_steps_per_execution_matches_single(tmp_path):
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
         finals[1], finals[4],
     )
+
+
+def test_multi_step_composes_with_grad_accum():
+    """grad_accum_steps × multi_steps in one jitted program equals the
+    sequential accumulated steps (the flagship clm.sh config uses both)."""
+    model, cfg = tiny_clm()
+    mesh = make_mesh(MeshConfig(data=2))
+    prefix_len = SEQ - LATENTS
+
+    def init():
+        return model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32), prefix_len
+        )["params"]
+
+    loss_fn = clm_loss_fn(model, LATENTS)
+    batches = _batches(2)
+    keys = [jax.random.fold_in(jax.random.PRNGKey(5), i) for i in range(2)]
+
+    state, sh = create_train_state(init, optax.adam(1e-2), mesh)
+    step = make_train_step(loss_fn, mesh, sh, grad_accum_steps=2)
+    with mesh:
+        for i, b in enumerate(batches):
+            state, _ = step(state, shard_batch(b, mesh), keys[i])
+    ref_params = jax.device_get(state.params)
+
+    state, sh = create_train_state(init, optax.adam(1e-2), mesh)
+    both = make_train_step(loss_fn, mesh, sh, grad_accum_steps=2, multi_steps=2)
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    with mesh:
+        state, _ = both(
+            state, shard_batch(stacked, mesh, stacked_steps=True), jnp.stack(keys)
+        )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        jax.device_get(state.params), ref_params,
+    )

@@ -74,13 +74,15 @@ def straight_9(tmp_path_factory):
     return _fit(tmp_path_factory.mktemp("straight"), 9)
 
 
-def _assert_states_equal(a, b):
+def _assert_states_equal(a, b, *, rtol=0.0):
+    # rtol=0 only when both runs executed the *identical* compiled program;
+    # cross-program comparisons (fused scan vs single steps) use a tolerance
     for x, y in zip(jax.tree_util.tree_leaves(a.params),
                     jax.tree_util.tree_leaves(b.params)):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=0)
     for x, y in zip(jax.tree_util.tree_leaves(a.opt_state),
                     jax.tree_util.tree_leaves(b.opt_state)):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=0, atol=0)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=0)
 
 
 def test_kill_and_resume_matches_uninterrupted(tmp_path, straight_9):
@@ -106,7 +108,7 @@ def test_kill_and_resume_with_fused_blocks_matches(tmp_path, straight_9):
     )
 
     assert int(resumed.step) == int(straight_9.step) == 9
-    _assert_states_equal(straight_9, resumed)
+    _assert_states_equal(straight_9, resumed, rtol=1e-6)
 
 
 def test_resume_manager_round_trip(tmp_path):
