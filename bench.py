@@ -51,6 +51,20 @@ parent accepts a partial file even if the child dies later. If the
 accelerator is unusable it falls back to a reduced-shape CPU run so a real
 measured number is always emitted; and it ALWAYS prints a parseable JSON
 line before exiting. All stage progress goes to stderr.
+
+Tunnel-outage resilience (round-3 postmortem: the axon relay died
+mid-session, two probes timed out at rc=-1, and the round silently forfeited
+to CPU): the accelerator here is reached through a loopback relay
+(``PALLAS_AXON_POOL_IPS=127.0.0.1``, ports 8080-8089). When that relay is
+down the PJRT claim HANGS rather than erroring, so a plain TCP connect to
+the relay ports is the only cheap tell. The parent now (a) socket-checks the
+relay before paying for a JAX-import probe, (b) retries the probe with
+backoff over a multi-minute window instead of twice, (c) records WHY the
+accelerator was unavailable (``tpu_status``: "relay_down" = nothing
+listening, vs "probe_failed" = listener present but backend broken), and
+(d) after the CPU fallback, re-probes once more so a mid-session outage that
+heals does not forfeit the round. Budget knobs: ``BENCH_DEADLINE_S`` (global,
+default 900), ``BENCH_PROBE_WINDOW_S`` (initial probe window, default 240).
 """
 from __future__ import annotations
 
@@ -61,8 +75,12 @@ import sys
 import tempfile
 import time
 
-GLOBAL_DEADLINE_S = 540.0  # parent always prints JSON before this
+GLOBAL_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "900"))
 _T0 = time.monotonic()
+
+# The axon relay's loopback ports (memory: healthy relay listens on 808x).
+RELAY_HOST = "127.0.0.1"
+RELAY_PORTS = tuple(range(8080, 8090))
 
 METRIC = "perceiver_ar_8k_train_tokens_per_sec_per_chip"
 
@@ -650,49 +668,126 @@ def _read_result(out_path):
     return None, None
 
 
+def relay_port():
+    """First relay port accepting a TCP connect, else None. No jax involved —
+    this is the cheap 'is the tunnel alive at all' check: a dead relay makes
+    the PJRT claim hang (not fail), so only a socket probe can tell
+    relay-down from backend-broken."""
+    import socket
+
+    for p in RELAY_PORTS:
+        try:
+            with socket.create_connection((RELAY_HOST, p), timeout=1.0):
+                return p
+        except OSError:
+            continue
+    return None
+
+
+def patient_probe(window_s: float, note: list, *, spawn=None, sleep=time.sleep,
+                  now=time.monotonic):
+    """Probe the accelerator repeatedly for up to ``window_s`` seconds.
+
+    Returns (ok, status): status is "ok" | "relay_down" | "probe_failed" |
+    "unprobed". When the accelerator is tunneled (PALLAS_AXON_POOL_IPS set),
+    each JAX probe is gated on a relay socket check — while nothing listens,
+    we wait-and-recheck (cheap) instead of burning a 90 s PJRT-claim hang.
+    ``spawn``/``sleep``/``now`` are injectable for tests.
+    """
+    spawn = spawn or _spawn
+    t_end = now() + window_s
+    tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    status = "unprobed"
+    attempt = 0
+    while now() < t_end:
+        if tunneled:
+            port = relay_port()
+            if port is None:
+                if status != "relay_down":
+                    log(f"probe: no relay listener on {RELAY_HOST}:"
+                        f"{RELAY_PORTS[0]}-{RELAY_PORTS[-1]} — relay down, waiting")
+                status = "relay_down"
+                if now() + 15.0 >= t_end:
+                    break
+                sleep(15.0)
+                continue
+            log(f"probe: relay listener up on port {port}")
+        attempt += 1
+        budget = min(90.0, t_end - now(), remaining() - 120.0)
+        if budget < 20.0:
+            break
+        log(f"probe attempt {attempt} (timeout {budget:.0f}s)")
+        rc, out = spawn(["--probe"], timeout=budget)
+        if rc == 0 and "PROBE_OK" in out:
+            return True, "ok"
+        status = "probe_failed"
+        detail = " (relay listener present)" if tunneled else ""
+        note.append(f"accelerator probe attempt {attempt} failed rc={rc}{detail}")
+        log(f"probe attempt {attempt} failed (rc={rc}){detail}")
+        if rc != -1 and attempt >= 2:
+            # Fast deterministic failure (not a timeout): the backend is
+            # reproducibly broken — more retries only burn the deadline.
+            break
+        backoff = min(10.0 * attempt, 30.0)
+        if now() + backoff >= t_end:
+            break  # window can't fit another attempt; don't sleep past it
+        sleep(backoff)
+    if status == "relay_down":
+        note.append(
+            f"tpu relay down: no listener on {RELAY_HOST} ports "
+            f"{RELAY_PORTS[0]}-{RELAY_PORTS[-1]}"
+        )
+    return False, status
+
+
+def _run_accel_bench(note):
+    """Spawn the full-shape accelerator benchmark child. Returns
+    (result_or_None, withdrawal_or_None)."""
+    budget = max(60.0, remaining() - 110.0)
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        out_path = f.name
+    log(f"accelerator benchmark (timeout {budget:.0f}s)")
+    rc, _ = _spawn(["--run", "full", out_path, f"{budget - 10:.0f}"], timeout=budget)
+    result, withdrawal = _read_result(out_path)
+    if withdrawal:
+        note.append(f"metric withdrawn: {withdrawal}")
+        log(f"accelerator metric withdrawn: {withdrawal}")
+    elif result is None:
+        note.append(f"accelerator benchmark failed rc={rc}")
+        log(f"accelerator benchmark failed (rc={rc})")
+    elif rc != 0:
+        note.append(f"child exited rc={rc}; partial result accepted")
+    return result, withdrawal
+
+
 def main() -> None:
     result = None
     withdrawal = None
     note = []
 
-    # Stage 1: probe the default (accelerator) backend, with retry/backoff.
-    accel_ok = False
-    for attempt in range(2):
-        budget = min(90.0, remaining() - 240.0)
-        if budget < 20.0:
-            note.append("probe skipped: out of time budget")
-            break
-        log(f"probe attempt {attempt + 1} (timeout {budget:.0f}s)")
-        rc, out = _spawn(["--probe"], timeout=budget)
-        if rc == 0 and "PROBE_OK" in out:
-            accel_ok = True
-            break
-        log(f"probe attempt {attempt + 1} failed (rc={rc})")
-        note.append(f"accelerator probe attempt {attempt + 1} failed rc={rc}")
-        time.sleep(5 * (attempt + 1))
+    # Stage 1: patient probe — socket-gated, retry with backoff over a
+    # multi-minute window (round-3 postmortem: two quick rc=-1 probes
+    # forfeited the round to CPU when the relay flapped).
+    probe_window = min(
+        float(os.environ.get("BENCH_PROBE_WINDOW_S", "240")), remaining() - 300.0
+    )
+    accel_ok, tpu_status = False, "unprobed"
+    if probe_window >= 20.0:
+        accel_ok, tpu_status = patient_probe(probe_window, note)
+    else:
+        note.append("probe skipped: out of time budget")
 
     # Stage 2: the real benchmark on the accelerator.
     if accel_ok:
-        budget = max(60.0, remaining() - 110.0)
-        with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
-            out_path = f.name
-        log(f"accelerator benchmark (timeout {budget:.0f}s)")
-        rc, _ = _spawn(["--run", "full", out_path, f"{budget - 10:.0f}"], timeout=budget)
-        result, withdrawal = _read_result(out_path)
-        if withdrawal:
-            note.append(f"metric withdrawn: {withdrawal}")
-            log(f"accelerator metric withdrawn: {withdrawal}")
-        elif result is None:
-            note.append(f"accelerator benchmark failed rc={rc}")
-            log(f"accelerator benchmark failed (rc={rc})")
-        elif rc != 0:
-            note.append(f"child exited rc={rc}; partial result accepted")
+        result, withdrawal = _run_accel_bench(note)
+        if not withdrawal and (result is None or result.get("platform") != "tpu"):
+            tpu_status = "bench_failed"  # probe passed but no TPU record
 
     # Stage 3: CPU fallback with reduced shapes so a measured number exists.
     # A deliberate withdrawal (kernel mismatch) must NOT be papered over by
     # a passing-looking CPU record — the zero record carries the error.
     if result is None and not withdrawal:
-        budget = max(60.0, remaining() - 20.0)
+        budget = min(300.0, max(60.0, remaining() - 120.0))
         with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
             out_path = f.name
         log(f"cpu fallback benchmark (timeout {budget:.0f}s)")
@@ -704,6 +799,29 @@ def main() -> None:
             note.append(f"cpu fallback failed rc={rc}")
             log(f"cpu fallback failed (rc={rc})")
 
+    # Stage 4: late re-probe — a mid-session outage that heals before the
+    # deadline must not forfeit the round to the CPU record.
+    if (
+        not withdrawal
+        and (result is None or result.get("platform") != "tpu")
+        and remaining() > 300.0
+    ):
+        log("late re-probe: checking whether the accelerator came back")
+        ok2, status2 = patient_probe(min(90.0, remaining() - 240.0), note)
+        if ok2:
+            late, withdrawal = _run_accel_bench(note)
+            if withdrawal:
+                result = None
+                tpu_status = "ok"
+            elif late is not None and late.get("platform") == "tpu":
+                result = late
+                tpu_status = "ok"
+                note.append("accelerator recovered on late re-probe")
+            else:
+                tpu_status = "bench_failed"
+        elif status2 != "unprobed":
+            tpu_status = status2  # report the freshest failure cause
+
     if result is None:
         result = {
             "metric": METRIC,
@@ -711,8 +829,12 @@ def main() -> None:
             "unit": "tokens/s",
             "vs_baseline": 0.0,
         }
+        # A zeroed record must not carry a note claiming a measured value
+        # (e.g. a CPU measurement discarded by a later metric withdrawal).
+        note = [n for n in note if not n.startswith("value measured")]
+    result["tpu_status"] = tpu_status
     if note:
-        result["note"] = "; ".join(note)
+        result["note"] = "; ".join(dict.fromkeys(note))  # dedupe, keep order
     print(json.dumps(result), flush=True)
 
 

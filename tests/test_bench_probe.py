@@ -1,0 +1,160 @@
+"""Unit tests for bench.py's tunnel-outage resilience (VERDICT r3 ask #2).
+
+The round-3 bench forfeited to CPU after two quick rc=-1 probes while the
+axon relay was down. These tests pin the new parent-side behavior:
+
+- ``relay_port``: plain-socket detection of the loopback relay (a dead relay
+  makes the PJRT claim *hang*, so the socket check is the only cheap tell);
+- ``patient_probe``: socket-gated retry loop that distinguishes
+  "relay_down" (nothing listening — wait and recheck, never spawn a probe
+  child) from "probe_failed" (listener present, backend broken — retry with
+  backoff);
+- ``main``: always prints one JSON line carrying ``tpu_status`` and the
+  failure trail in ``note``.
+
+All child-process spawns are stubbed: no jax, no subprocesses.
+"""
+import importlib.util
+import json
+import os
+import socket
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def fake_clock(bench, monkeypatch):
+    """Deterministic time: sleep() advances the clock, nothing waits."""
+    clock = {"t": 0.0}
+
+    def now():
+        return clock["t"]
+
+    def sleep(s):
+        clock["t"] += s
+
+    # Keep remaining() large so the per-attempt budget check never triggers.
+    monkeypatch.setattr(bench, "_T0", bench.time.monotonic())
+    monkeypatch.setattr(bench, "GLOBAL_DEADLINE_S", 10_000.0)
+    return now, sleep
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_relay_port_none_when_nothing_listens(bench, monkeypatch):
+    monkeypatch.setattr(bench, "RELAY_PORTS", (_free_port(),))
+    assert bench.relay_port() is None
+
+
+def test_relay_port_finds_listener(bench, monkeypatch):
+    with socket.socket() as srv:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        monkeypatch.setattr(bench, "RELAY_PORTS", (_free_port(), port))
+        assert bench.relay_port() == port
+
+
+def test_patient_probe_relay_down_never_spawns(bench, monkeypatch, fake_clock):
+    """No listener → wait/recheck inside the window, report relay_down, and
+    never pay for a JAX probe child (which would hang on the PJRT claim)."""
+    now, sleep = fake_clock
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setattr(bench, "RELAY_PORTS", (_free_port(),))
+    spawned = []
+
+    def spawn(args, timeout):
+        spawned.append(args)
+        return 0, "PROBE_OK"
+
+    note = []
+    ok, status = bench.patient_probe(60.0, note, spawn=spawn, sleep=sleep, now=now)
+    assert (ok, status) == (False, "relay_down")
+    assert spawned == []  # socket gate held: no probe child while relay down
+    assert any("relay down" in n for n in note)
+    assert now() >= 45.0  # it genuinely waited out the window in 15s steps
+
+
+def test_patient_probe_backend_broken_retries_with_backoff(bench, monkeypatch, fake_clock):
+    """Listener present but probe child fails → probe_failed, with retries."""
+    now, sleep = fake_clock
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    with socket.socket() as srv:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(128)  # relay_port() probes fill the accept queue otherwise
+        monkeypatch.setattr(bench, "RELAY_PORTS", (srv.getsockname()[1],))
+        attempts = []
+
+        def spawn(args, timeout):
+            attempts.append(now())
+            return -1, "TIMEOUT"
+
+        note = []
+        ok, status = bench.patient_probe(120.0, note, spawn=spawn, sleep=sleep, now=now)
+    assert (ok, status) == (False, "probe_failed")
+    assert len(attempts) >= 2  # retried within the window
+    assert all("relay listener present" in n for n in note)
+
+
+def test_patient_probe_recovers_mid_window(bench, monkeypatch, fake_clock):
+    """Relay comes back during the window → probe succeeds → ok."""
+    now, sleep = fake_clock
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    # Down for the first two checks, then up (relay_port is stubbed: the
+    # socket-level behavior is covered by the tests above).
+    calls = {"n": 0}
+
+    def flappy_relay_port():
+        calls["n"] += 1
+        return None if calls["n"] <= 2 else 8080
+
+    monkeypatch.setattr(bench, "relay_port", flappy_relay_port)
+    note = []
+    ok, status = bench.patient_probe(
+        300.0, note, spawn=lambda a, timeout: (0, "PROBE_OK"), sleep=sleep, now=now
+    )
+    assert (ok, status) == (True, "ok")
+    assert now() >= 30.0  # waited through the outage before probing
+
+
+def test_untunneled_probe_skips_socket_gate(bench, monkeypatch, fake_clock):
+    """Without PALLAS_AXON_POOL_IPS (real TPU, CI) the relay check is
+    bypassed and the probe child runs directly."""
+    now, sleep = fake_clock
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setattr(bench, "RELAY_PORTS", (_free_port(),))  # nothing listens
+    ok, status = bench.patient_probe(
+        60.0, [], spawn=lambda a, timeout: (0, "PROBE_OK"), sleep=sleep, now=now
+    )
+    assert (ok, status) == (True, "ok")
+
+
+def test_main_emits_json_with_tpu_status_on_total_failure(bench, monkeypatch, capsys):
+    """Everything fails fast → still exactly one parseable JSON line, with
+    tpu_status and the failure trail in note."""
+    monkeypatch.setenv("BENCH_PROBE_WINDOW_S", "0")
+    monkeypatch.setattr(bench, "_spawn", lambda args, timeout, env_extra=None: (1, ""))
+    # remaining() small enough to skip the late re-probe (needs > 300 s).
+    monkeypatch.setattr(bench, "_T0", bench.time.monotonic())
+    monkeypatch.setattr(bench, "GLOBAL_DEADLINE_S", 200.0)
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["value"] == 0.0
+    assert rec["tpu_status"] == "unprobed"
+    assert "cpu fallback failed" in rec["note"]
