@@ -64,8 +64,11 @@ def make_batch(rng, batch_size=8):
     return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
 
-def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=2**14, shard_seq=False,
+def run_steps(mesh_config, n_steps=3, batch_size=8, min_fsdp_size=0, shard_seq=False,
               grad_accum_steps=1):
+    # min_fsdp_size=0: the tiny test model's leaves are all below the
+    # production 2**14 threshold, so the default would leave every param
+    # replicated and the FSDP parity cases would never exercise sharding.
     model = tiny_clm()
     mesh = make_mesh(mesh_config)
     rng = np.random.default_rng(0)
@@ -149,8 +152,7 @@ def test_grad_accumulation_rejects_indivisible_batch():
 
 
 def test_fsdp_actually_shards_params_and_opt_state():
-    # min_fsdp_size=0: the test model is tiny, so force sharding of all leaves.
-    _, state, mesh = run_steps(MeshConfig(data=1, fsdp=8), n_steps=1, min_fsdp_size=0)
+    _, state, mesh = run_steps(MeshConfig(data=1, fsdp=8), n_steps=1)
     emb = state.params["perceiver_ar"]["input_adapter"]["txt_embedding"]["embedding"]
     assert emb.sharding.spec != jax.sharding.PartitionSpec()  # sharded
     # Adam mu mirrors the param sharding (ZeRO-style optimizer sharding).
