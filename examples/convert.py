@@ -20,9 +20,20 @@ reference-backend layout):
     python examples/convert.py mlm mlm.ckpt out_dir            # 201M default shape
     python examples/convert.py txt-clf txt_clf.ckpt out_dir --num-classes 2
 
+Export (the reverse direction — reference ``examples/convert.py:14-89``
+produces the same artifact from Lightning checkpoints): a model trained in
+this framework (``save_pretrained`` dir or trainer checkpoint dir) → a
+reference-format ``save_pretrained`` directory (``config.json`` +
+``backend_model.``-prefixed ``pytorch_model.bin``) the reference library
+loads with ``Perceiver<Task>.from_pretrained``:
+
+    python examples/convert.py export clm trained_model_dir out_dir
+    python examples/convert.py export mlm trained_model_dir out_dir
+
 Key mappings live in ``perceiver_io_tpu/convert/`` (``torch_import`` for the
-reference layout, ``hf_import`` for transformers state dicts), each
-parity-tested in ``tests/test_torch_parity.py`` / ``tests/test_hf_convert.py``.
+reference layout, ``hf_import`` for transformers state dicts, ``export`` for
+the reverse direction), each parity-tested in ``tests/test_torch_parity.py``
+/ ``tests/test_hf_convert.py`` / ``tests/test_export.py``.
 """
 from __future__ import annotations
 
@@ -94,8 +105,32 @@ def _mlm_config(args):
     )
 
 
+def export_main(argv) -> None:
+    parser = argparse.ArgumentParser(
+        prog="convert.py export",
+        description="Export a trained model to the reference (torch) "
+        "save_pretrained format.",
+    )
+    parser.add_argument("task", choices=["clm", "sam", "mlm", "img-clf", "flow", "txt-clf"])
+    parser.add_argument("model_dir", help="save_pretrained dir or trainer checkpoint dir")
+    parser.add_argument("out_dir")
+    args = parser.parse_args(argv)
+
+    import perceiver_io_tpu.convert as convert
+    from perceiver_io_tpu.training.checkpoint import load_pretrained
+
+    params, cfg = load_pretrained(args.model_dir)
+    if cfg is None:
+        raise SystemExit(f"{args.model_dir} carries no model config; cannot export")
+    convert.save_reference_checkpoint(params, cfg, args.out_dir, args.task)
+    print(f"exported {args.task} model to reference format at {args.out_dir}")
+
+
 def main() -> None:
     _force_cpu()
+    if len(sys.argv) > 1 and sys.argv[1] == "export":
+        export_main(sys.argv[2:])
+        return
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )

@@ -1,3 +1,12 @@
+from perceiver_io_tpu.convert.export import (
+    export_causal_language_model,
+    export_image_classifier,
+    export_masked_language_model,
+    export_optical_flow,
+    export_symbolic_audio_model,
+    export_text_classifier,
+    save_reference_checkpoint,
+)
 from perceiver_io_tpu.convert.torch_import import (
     import_causal_language_model,
     import_image_classifier,

@@ -56,3 +56,56 @@ def test_convert_cli_clm_lightning_ckpt(tmp_path):
         t_out = t_model(torch.tensor(ids), prefix_len=5).numpy()
     j_out = np.asarray(model.apply({"params": params}, jnp.asarray(ids), 5))
     np.testing.assert_allclose(j_out, t_out, atol=1e-4, rtol=1e-4)
+
+
+def test_convert_cli_export_roundtrip(tmp_path):
+    """import CLI → export CLI → the artifact strict-loads into the real
+    reference torch model and reproduces its logits (the full three-form
+    round trip, reference docs/library-design.md:17-50)."""
+    kw = dict(
+        vocab_size=262, max_seq_len=16, max_latents=8, num_channels=16,
+        num_self_attention_layers=1, init_scale=0.1,
+    )
+    t_model = ref.clm.CausalLanguageModel(ref.clm.CausalLanguageModelConfig(**kw)).eval()
+    ckpt = tmp_path / "epoch=000-val_loss=0.0.ckpt"
+    torch.save(
+        {"state_dict": {f"model.{k}": v for k, v in t_model.state_dict().items()}},
+        ckpt,
+    )
+
+    imported = tmp_path / "imported"
+    proc = subprocess.run(
+        [
+            sys.executable, "examples/convert.py", "clm", str(ckpt), str(imported),
+            "--vocab-size", "262", "--max-seq-len", "16", "--max-latents", "8",
+            "--num-channels", "16", "--num-layers", "1",
+        ],
+        capture_output=True, text=True, cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    exported = tmp_path / "exported"
+    proc = subprocess.run(
+        [sys.executable, "examples/convert.py", "export", "clm", str(imported), str(exported)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    import json
+
+    with open(exported / "config.json") as f:
+        cfg = json.load(f)
+    assert cfg["model_type"] == "perceiver-ar-causal-language-model"
+    fresh = ref.clm.CausalLanguageModel(
+        ref.clm.CausalLanguageModelConfig.create(**cfg["model_config"])
+    ).eval()
+    sd = torch.load(exported / "pytorch_model.bin", weights_only=True)
+    fresh.load_state_dict(
+        {k.removeprefix("backend_model."): v for k, v in sd.items()}, strict=True
+    )
+
+    ids = np.random.default_rng(0).integers(0, 262, (2, 12))
+    with torch.no_grad():
+        want = t_model(torch.tensor(ids), prefix_len=5).numpy()
+        got = fresh(torch.tensor(ids), prefix_len=5).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
