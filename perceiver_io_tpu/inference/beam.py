@@ -222,7 +222,9 @@ def _build_beam_executor(
             carry = (window, pad_count, m, new_scores, tok_buf, hyp_scores, hyp_tokens)
             return carry, None
 
-        tok_buf = jnp.zeros((b, k, t_max), jnp.int32)
+        # pad-filled, not zeros: a finished hypothesis's history is copied
+        # into the pool wholesale, so post-EOS slots must already hold pad.
+        tok_buf = jnp.full((b, k, t_max), config.pad_token_id, jnp.int32)
         hyp_scores = jnp.full((b, k), -jnp.inf, jnp.float32)
         hyp_tokens = jnp.full((b, k, t_max), config.pad_token_id, jnp.int32)
         carry = (

@@ -144,11 +144,13 @@ class TestBeamParity:
     cross-framework logit noise (torch/oneDNN vs XLA, ~1e-4 per step at this
     scale) flips candidate order at genuine near-ties. Measured on the
     (4,2,14,3) case: at step 3 the two frontrunner continuations differ by
-    1.3e-4 in accumulated score; an eager re-implementation of HF-4.57 beam
-    semantics driven by *our* logits reproduces our scan's choice exactly, so
-    the divergence is numeric, not bookkeeping. The fallback oracle therefore
-    asserts both searches found near-equally-good sequences: length-normalized
-    teacher-forced scores (under the same jax model) within 0.02 nats."""
+    1.3e-4 in accumulated score; the eager re-implementation of HF-4.57 beam
+    semantics driven by *our* logits (tests/_eager_beam.py, pinned
+    token-exactly by TestEagerBeamBookkeeping below) reproduces our scan's
+    choice exactly, so the divergence is numeric, not bookkeeping. The
+    fallback oracle therefore asserts both searches found near-equally-good
+    sequences: length-normalized teacher-forced scores (under the same jax
+    model) within 0.02 nats."""
 
     @pytest.mark.parametrize(
         "prompt_len,num_latents,new_tokens,num_beams",
@@ -217,6 +219,67 @@ class TestBeamParity:
             hits = np.where(row == 5)[0]
             if hits.size:
                 assert (row[hits[0] + 1 :] == 0).all()
+
+
+class TestEagerBeamBookkeeping:
+    """The near-tie fallback in TestBeamParity is sound only while "our
+    logits through exact HF beam bookkeeping = our scan" holds (VERDICT r3
+    ask #6). This pins it: an independent imperative HF-4.57-style beam
+    search (tests/_eager_beam.py), fed the SAME jax logits, must match the
+    scan token-for-token with ZERO tolerance — both searches see
+    bit-identical fp32 scores, so near-ties cannot excuse a mismatch. A
+    bookkeeping regression in inference/beam.py that stays inside the
+    0.02-nat parity tolerance fails here."""
+
+    @pytest.mark.parametrize(
+        "prompt_len,num_latents,new_tokens,num_beams",
+        [
+            (4, 2, 4, 3),     # latent growth only
+            (4, 2, 14, 3),    # crosses prefix growth and slide
+            (12, 8, 10, 2),   # starts at max latents
+        ],
+    )
+    def test_scan_matches_eager_bookkeeping(
+        self, models, prompt_len, num_latents, new_tokens, num_beams
+    ):
+        from tests._eager_beam import eager_beam_search
+
+        _, j_model, params = models
+        ids = np.random.default_rng(4).integers(1, KW["vocab_size"], (2, prompt_len))
+        cfg = GenerationConfig(
+            max_new_tokens=new_tokens,
+            num_latents=num_latents,
+            num_beams=num_beams,
+            min_new_tokens=new_tokens,
+        )
+        got = np.asarray(generate(j_model, params, jnp.asarray(ids), cfg))
+        want = eager_beam_search(j_model, params, ids, cfg)
+        np.testing.assert_array_equal(got, want)
+
+    def test_scan_matches_eager_bookkeeping_with_eos(self, models):
+        """EOS path: hypothesis-pool insertion, worst-eviction, and
+        finalization against live beams must also agree exactly. The EOS id
+        is chosen from a beam continuation so the path genuinely fires."""
+        from tests._eager_beam import eager_beam_search
+
+        _, j_model, params = models
+        ids = np.random.default_rng(8).integers(1, KW["vocab_size"], (2, 4))
+        base = GenerationConfig(max_new_tokens=10, num_latents=2, num_beams=3)
+        probe = np.asarray(generate(j_model, params, jnp.asarray(ids), base))
+        fired = False
+        for eos in {int(probe[0, 2]), int(probe[1, 5]), 5}:
+            # pad_token_id deliberately nonzero: post-EOS slots must carry
+            # the configured pad, not the buffer's fill value (a real scan
+            # bug this checker caught on first use).
+            cfg = GenerationConfig(
+                max_new_tokens=10, num_latents=2, num_beams=3,
+                eos_token_id=eos, pad_token_id=7,
+            )
+            got = np.asarray(generate(j_model, params, jnp.asarray(ids), cfg))
+            want = eager_beam_search(j_model, params, ids, cfg)
+            np.testing.assert_array_equal(got, want)
+            fired = fired or (got == eos).any()
+        assert fired, "no EOS ever fired — the hypothesis-pool path went untested"
 
 
 class TestValidation:
