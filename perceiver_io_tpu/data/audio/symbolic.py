@@ -148,14 +148,22 @@ class SymbolicAudioDataModule:
         return self.dataset_dir / "preproc"
 
     def load_source_dataset(self) -> Dict[str, object]:
-        """Return ``{"train": ..., "valid": ...}`` MIDI sources.
+        """Return ``{"train": ..., "valid": ...}`` (optionally ``"test"``)
+        MIDI sources.
 
         Each value is either a directory (``rglob``-ed for ``.mid``/``.midi``)
         or an explicit list of files (manifest- or bucket-derived splits).
-        Train and valid must be disjoint — overlapping splits leak training
-        data into validation and make val_loss meaningless.
+        Splits must be disjoint — overlap leaks training data into
+        evaluation and makes the metrics meaningless.
         """
         raise NotImplementedError
+
+    def split_signature(self) -> str:
+        """Configuration that determines split *membership* (not content).
+        Stored in the preproc manifest; a cache built under a different
+        signature is refused instead of silently reusing wrong splits.
+        Default "" matches caches written before this hook existed."""
+        return ""
 
     @staticmethod
     def _midi_files(source) -> List[Path]:
@@ -166,13 +174,20 @@ class SymbolicAudioDataModule:
 
     @classmethod
     def from_token_streams(
-        cls, train: np.ndarray, valid: np.ndarray, max_seq_len: int, **kwargs
+        cls,
+        train: np.ndarray,
+        valid: np.ndarray,
+        max_seq_len: int,
+        test: Optional[np.ndarray] = None,
+        **kwargs,
     ) -> "SymbolicAudioDataModule":
         dm = cls(dataset_dir=".", max_seq_len=max_seq_len, **kwargs)
         dm._splits = {
             "train": np.asarray(train, np.int16),
             "valid": np.asarray(valid, np.int16),
         }
+        if test is not None:
+            dm._splits["test"] = np.asarray(test, np.int16)
         return dm
 
     @staticmethod
@@ -189,25 +204,42 @@ class SymbolicAudioDataModule:
         if self._splits:
             return
         if self.preproc_dir.exists():
+            import json
+
             # Caches written before disjoint splits existed have no manifest
             # and were built with train == valid — refuse to reuse them.
-            if not (self.preproc_dir / "split_manifest.json").exists():
+            manifest_file = self.preproc_dir / "split_manifest.json"
+            if not manifest_file.exists():
                 raise ValueError(
                     f"{self.preproc_dir} was built by an older version with "
                     "overlapping train/valid splits (no split_manifest.json); "
                     "delete it and re-run preprocessing"
                 )
+            stored = json.loads(manifest_file.read_text()).get("_signature", "")
+            if stored != self.split_signature():
+                raise ValueError(
+                    f"{self.preproc_dir} was preprocessed under a different "
+                    f"split configuration ({stored!r} vs "
+                    f"{self.split_signature()!r}) — reusing it would mix "
+                    "split memberships; delete it and re-run preprocessing"
+                )
             return
         sources = self.load_source_dataset()
-        split_files = {s: self._midi_files(sources[s]) for s in ("train", "valid")}
-        overlap = set(map(str, split_files["train"])) & set(map(str, split_files["valid"]))
-        if overlap:
-            raise ValueError(
-                f"train/valid splits overlap on {len(overlap)} files "
-                f"(e.g. {sorted(overlap)[0]}) — validation would leak training data"
-            )
+        names = [s for s in ("train", "valid", "test") if s in sources]
+        split_files = {s: self._midi_files(sources[s]) for s in names}
+        for a in names:
+            for b in names:
+                if a >= b:
+                    continue
+                overlap = set(map(str, split_files[a])) & set(map(str, split_files[b]))
+                if overlap:
+                    raise ValueError(
+                        f"{a}/{b} splits overlap on {len(overlap)} files "
+                        f"(e.g. {sorted(overlap)[0]}) — evaluation would leak "
+                        "training data"
+                    )
         os.makedirs(self.preproc_dir)
-        for split in ("train", "valid"):
+        for split in names:
             files = split_files[split]
             pieces = encode_midi_files(files, num_workers=self.preproc_workers)
             flat = self.flatten_pieces(
@@ -220,16 +252,17 @@ class SymbolicAudioDataModule:
             fp.flush()
         import json
 
-        (self.preproc_dir / "split_manifest.json").write_text(
-            json.dumps({s: [str(f) for f in split_files[s]] for s in ("train", "valid")})
-        )
+        manifest = {s: [str(f) for f in split_files[s]] for s in names}
+        manifest["_signature"] = self.split_signature()
+        (self.preproc_dir / "split_manifest.json").write_text(json.dumps(manifest))
 
     def setup(self) -> None:
         if self._splits:
             return
         self._splits = {
             split: np.memmap(self.preproc_dir / f"{split}.bin", np.int16, mode="r")
-            for split in ("train", "valid")
+            for split in ("train", "valid", "test")
+            if (self.preproc_dir / f"{split}.bin").exists()
         }
 
     # -- loaders -----------------------------------------------------------
@@ -258,6 +291,16 @@ class SymbolicAudioDataModule:
         # validation always uses full windows (reference symbolic.py:133-137)
         return self._loader("valid", None)
 
+    def test_dataloader(self) -> DataLoader:
+        if "test" not in self._splits:
+            raise ValueError(
+                f"{type(self).__name__} materialized no test split — either "
+                "the source provides none, or the preproc cache at "
+                f"{self.preproc_dir} predates test-split support; in the "
+                "latter case delete it and re-run preprocessing"
+            )
+        return self._loader("test", None)
+
 
 class MaestroV3DataModule(SymbolicAudioDataModule):
     """MAESTRO v3 piano corpus: expects the extracted archive at
@@ -266,8 +309,9 @@ class MaestroV3DataModule(SymbolicAudioDataModule):
 
     Splits follow the official ``maestro-v3.0.0.json`` manifest exactly as
     the reference does (``maestro_v3.py:58-76``): columnar
-    ``metadata["midi_filename"]``/``metadata["split"]``, ``train`` →
-    train, ``validation`` → valid, ``test`` excluded.
+    ``metadata["midi_filename"]``/``metadata["split"]``, ``train`` → train,
+    ``validation`` → valid, and ``test`` → the test split (which the
+    reference discards; here it feeds the CLI ``test`` subcommand).
     """
 
     def load_source_dataset(self) -> Dict[str, List[Path]]:
@@ -283,12 +327,10 @@ class MaestroV3DataModule(SymbolicAudioDataModule):
             raise FileNotFoundError(f"missing MAESTRO manifest {meta_file}")
         with open(meta_file) as f:
             metadata = json.load(f)
-        splits: Dict[str, List[Path]] = {"train": [], "valid": []}
+        splits: Dict[str, List[Path]] = {"train": [], "valid": [], "test": []}
+        names = {"train": "train", "validation": "valid", "test": "test"}
         for _id, file_path in metadata["midi_filename"].items():
-            split = metadata["split"][_id]
-            if split == "test":
-                continue
-            splits["train" if split == "train" else "valid"].append(root / file_path)
+            splits[names[metadata["split"][_id]]].append(root / file_path)
         return splits
 
 
@@ -304,7 +346,16 @@ class GiantMidiPianoDataModule(SymbolicAudioDataModule):
     """
 
     valid_bucket: int = 0
+    #: hash bucket carved out as the test split; ``None`` (default) keeps the
+    #: historical train/valid layout byte-identical (no test split).
+    test_bucket: Optional[int] = None
     num_buckets: int = 10
+
+    def split_signature(self) -> str:
+        # "" for the historical default so pre-existing caches stay valid.
+        if (self.valid_bucket, self.test_bucket, self.num_buckets) == (0, None, 10):
+            return ""
+        return f"buckets:{self.valid_bucket},{self.test_bucket},{self.num_buckets}"
 
     def load_source_dataset(self) -> Dict[str, object]:
         root = self.dataset_dir / "midis"
@@ -312,7 +363,10 @@ class GiantMidiPianoDataModule(SymbolicAudioDataModule):
             raise FileNotFoundError(f"{root} not found — place GiantMIDI midis there")
         train_dir, valid_dir = root / "train", root / "valid"
         if train_dir.exists() and valid_dir.exists():
-            return {"train": train_dir, "valid": valid_dir}
+            out = {"train": train_dir, "valid": valid_dir}
+            if (root / "test").exists():
+                out["test"] = root / "test"
+            return out
         if train_dir.exists() or valid_dir.exists():
             raise ValueError(
                 f"{root} has only one of train/valid — a partially extracted "
@@ -322,14 +376,19 @@ class GiantMidiPianoDataModule(SymbolicAudioDataModule):
         import zlib
 
         files = self._midi_files(root)
-        in_valid = [
-            zlib.crc32(f.name.encode()) % self.num_buckets == self.valid_bucket
-            for f in files
-        ]
-        return {
-            "train": [f for f, v in zip(files, in_valid) if not v],
-            "valid": [f for f, v in zip(files, in_valid) if v],
+        if self.test_bucket is not None and self.test_bucket == self.valid_bucket:
+            raise ValueError("test_bucket must differ from valid_bucket")
+        buckets = [zlib.crc32(f.name.encode()) % self.num_buckets for f in files]
+        out = {
+            "train": [
+                f for f, b in zip(files, buckets)
+                if b != self.valid_bucket and b != self.test_bucket
+            ],
+            "valid": [f for f, b in zip(files, buckets) if b == self.valid_bucket],
         }
+        if self.test_bucket is not None:
+            out["test"] = [f for f, b in zip(files, buckets) if b == self.test_bucket]
+        return out
 
 
 class SyntheticSymbolicAudioDataModule(SymbolicAudioDataModule):
@@ -346,11 +405,12 @@ class SyntheticSymbolicAudioDataModule(SymbolicAudioDataModule):
         dataset_dir: str = ".cache/synthetic_sam",
         num_train_pieces: int = 24,
         num_valid_pieces: int = 8,
+        num_test_pieces: int = 8,
         mean_piece_len: int = 4096,
         **kwargs,
     ):
         super().__init__(dataset_dir=dataset_dir, max_seq_len=max_seq_len, **kwargs)
-        self._gen = (num_train_pieces, num_valid_pieces, mean_piece_len)
+        self._gen = (num_train_pieces, num_valid_pieces, num_test_pieces, mean_piece_len)
 
     def prepare_data(self) -> None:  # nothing to download or encode
         pass
@@ -358,7 +418,7 @@ class SyntheticSymbolicAudioDataModule(SymbolicAudioDataModule):
     def setup(self) -> None:
         if self._splits:
             return
-        num_train, num_valid, mean_piece_len = self._gen
+        num_train, num_valid, num_test, mean_piece_len = self._gen
         rng = np.random.default_rng(self.seed)
         # sparse row-peaked transitions: each event strongly prefers a few
         # successors, so the stream has learnable structure
@@ -380,3 +440,9 @@ class SyntheticSymbolicAudioDataModule(SymbolicAudioDataModule):
                                          shuffle_seed=self.seed),
             "valid": self.flatten_pieces([piece() for _ in range(num_valid)]),
         }
+        if num_test:
+            # drawn after train/valid from the same stream: enabling the
+            # test split never changes the other two
+            self._splits["test"] = self.flatten_pieces(
+                [piece() for _ in range(num_test)]
+            )

@@ -169,6 +169,7 @@ class TextDataModule:
         self.seed = seed
         self.ds_train = None
         self.ds_valid = None
+        self.ds_test = None
 
     # -- source hook --------------------------------------------------------
     def load_source_dataset(self) -> Dict[str, object]:
@@ -209,8 +210,14 @@ class TextDataModule:
         source = self.load_source_dataset()
         os.makedirs(self.preproc_dir, exist_ok=True)
         meta = {"task": self.task.name, "splits": {}}
+        prepared: Dict[int, Dict[str, np.ndarray]] = {}
         for split, data in source.items():
-            arrays = self._prepare_split(data)
+            # Sources may alias one object across splits (e.g. IMDb's valid
+            # and test are both the official test split) — tokenize it once.
+            if id(data) in prepared:
+                arrays = prepared[id(data)]
+            else:
+                arrays = prepared.setdefault(id(data), self._prepare_split(data))
             for name, arr in arrays.items():
                 np.save(os.path.join(self.preproc_dir, f"{split}.{name}.npy"), arr)
             meta["splits"][split] = {
@@ -310,6 +317,9 @@ class TextDataModule:
     def setup(self) -> None:
         self.ds_train = self._load_split("train")
         self.ds_valid = self._load_split("valid")
+        if os.path.exists(os.path.join(self.preproc_dir, "test.input_ids.npy")):
+            # Deterministic: no random shift/truncation views on test.
+            self.ds_test = self._load_split("test")
         if self.task in (Task.clm, Task.mlm):
             if self.random_train_shift:
                 self.ds_train = RandomShiftView(self.ds_train, seed=self.seed)
@@ -318,6 +328,8 @@ class TextDataModule:
         if self.task == Task.clm:
             self.ds_train = CLMView(self.ds_train)
             self.ds_valid = CLMView(self.ds_valid)
+            if self.ds_test is not None:
+                self.ds_test = CLMView(self.ds_test)
 
     # -- collator / loaders (reference common.py:127-139,206-234) -----------
     def _base_collator(self):
@@ -343,6 +355,19 @@ class TextDataModule:
         return self._loader(
             self.ds_valid, self.valid_batch_size, False, self.random_valid_truncation, self.seed + 1
         )
+
+    def test_dataloader(self) -> DataLoader:
+        """Deterministic pass over the test split (CLI ``test`` subcommand,
+        reference LightningCLI fit/validate/test parity,
+        ``perceiver/scripts/cli.py:13-48``)."""
+        if self.ds_test is None:
+            raise ValueError(
+                f"{type(self).__name__} materialized no test split — either "
+                "the source dataset provides none (source_test_size=0), or "
+                f"the preproc cache at {self.preproc_dir} predates test-split "
+                "support; in the latter case delete it and re-run preproc"
+            )
+        return self._loader(self.ds_test, self.valid_batch_size, False, False, self.seed + 2)
 
     def text_preprocessor(self) -> TextPreprocessor:
         return TextPreprocessor(

@@ -122,6 +122,7 @@ class MNISTDataModule:
         cls,
         train: Tuple[np.ndarray, np.ndarray],
         valid: Tuple[np.ndarray, np.ndarray],
+        test: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         **kwargs,
     ) -> "MNISTDataModule":
         dm = cls(**kwargs)
@@ -129,10 +130,18 @@ class MNISTDataModule:
             "train": _ImageDataset(*train),
             "valid": _ImageDataset(*valid),
         }
+        if test is not None:
+            dm._splits["test"] = _ImageDataset(*test)
         return dm
 
     def load_arrays(self) -> None:
-        """Load MNIST from the local HF datasets cache."""
+        """Load MNIST from the local HF datasets cache.
+
+        MNIST publishes train + test only; following the reference, ``valid``
+        is the official test set (reference ``mnist.py:60``), and the
+        ``test`` split materializes that same official set for the CLI
+        ``test`` subcommand — the split the reference's MNIST val_acc numbers
+        are reported on."""
         import datasets
 
         ds = datasets.load_dataset("mnist")
@@ -140,6 +149,7 @@ class MNISTDataModule:
             imgs = np.stack([np.asarray(im) for im in ds[name]["image"]])[..., None]
             labels = np.asarray(ds[name]["label"], np.int64)
             self._splits[split] = _ImageDataset(imgs, labels)
+        self._splits["test"] = self._splits["valid"]  # same official set, one copy
 
     def prepare_data(self) -> None:
         """Source acquisition phase (the CLI calls this before ``setup``)."""
@@ -181,6 +191,14 @@ class MNISTDataModule:
     def val_dataloader(self) -> DataLoader:
         return self._loader("valid", shuffle=False)
 
+    def test_dataloader(self) -> DataLoader:
+        if "test" not in self._splits:
+            raise ValueError(
+                f"{type(self).__name__} has no test split — from_arrays was "
+                "called without test arrays"
+            )
+        return self._loader("test", shuffle=False)
+
 
 class SyntheticImageDataModule(MNISTDataModule):
     """Deterministic synthetic images — offline smoke runs and config
@@ -194,10 +212,11 @@ class SyntheticImageDataModule(MNISTDataModule):
         *,
         num_train: int = 512,
         num_valid: int = 128,
+        num_test: int = 128,
         **kwargs,
     ):
         super().__init__(batch_size, **kwargs)
-        self._sizes = {"train": num_train, "valid": num_valid}
+        self._sizes = {"train": num_train, "valid": num_valid, "test": num_test}
 
     def prepare_data(self) -> None:  # synthetic: nothing to acquire
         self.setup()
@@ -217,7 +236,8 @@ class SyntheticImageDataModule(MNISTDataModule):
                 return imgs.astype(np.uint8), labels.astype(np.int64)
 
             self._splits = {
-                "train": _ImageDataset(*split(self._sizes["train"])),
-                "valid": _ImageDataset(*split(self._sizes["valid"])),
+                name: _ImageDataset(*split(n))
+                for name, n in self._sizes.items()
+                if n > 0
             }
         super().setup()

@@ -14,7 +14,9 @@ defaults (CLI flags win), mirroring the reference's ``trainer.yaml`` default
 config file; ``link`` functions propagate data-derived values into the model
 config (``link_arguments`` parity, e.g. vocab_size — reference
 ``scripts/text/mlm.py:12-16``). Subcommands: ``fit``, ``validate``,
-``preproc``.
+``test``, ``preproc`` (the reference LightningCLI exposes
+fit/validate/test, ``perceiver/scripts/cli.py:13-48``); ``validate`` and
+``test`` take ``--ckpt <dir>`` to evaluate a saved model.
 
 Model-family entry points are declarative :class:`ModelFamily` records; see
 ``perceiver_io_tpu/scripts/text/clm.py`` for the pattern.
@@ -213,7 +215,7 @@ class CLI:
     def _known_flags(self, data_cls) -> Dict[str, Any]:
         from perceiver_io_tpu.training.trainer import TrainerConfig
 
-        known: Dict[str, Any] = {"config": str, "data": str, "params": str}
+        known: Dict[str, Any] = {"config": str, "data": str, "params": str, "ckpt": str}
         known.update(flag_specs(self.family.config_class, "model", self.family.nested))
         known.update(_ctor_flag_specs(data_cls, "data"))
         known.update(flag_specs(TrainerConfig, "trainer"))
@@ -231,8 +233,10 @@ class CLI:
             self._print_help()
             return None
         subcommand = argv[0]
-        if subcommand not in ("fit", "validate", "preproc"):
-            raise SystemExit(f"unknown subcommand {subcommand!r} (fit|validate|preproc)")
+        if subcommand not in ("fit", "validate", "test", "preproc"):
+            raise SystemExit(
+                f"unknown subcommand {subcommand!r} (fit|validate|test|preproc)"
+            )
 
         # data module choice first (its ctor defines the --data.* space)
         data_name = None
@@ -335,19 +339,25 @@ class CLI:
             ]
 
         initial = None
-        if values.get("params"):
-            # Full-model warm start from a save_pretrained dir (reference
-            # ``--model.params`` reload, ``clm/lightning.py:44-52``).
+        if values.get("ckpt") or values.get("params"):
+            # Full-model warm start from a save_pretrained dir or trainer
+            # checkpoint dir (reference ``--model.params`` reload,
+            # ``clm/lightning.py:44-52``; ``--ckpt`` is the evaluation-time
+            # spelling, matching the reference's ``test --ckpt_path``).
             from perceiver_io_tpu.training.checkpoint import load_pretrained
 
-            initial, _ = load_pretrained(values["params"])
+            initial, _ = load_pretrained(values.get("ckpt") or values["params"])
         elif self.family.initial_params is not None:
             initial = self.family.initial_params(model, model_cfg, dm)
 
-        if subcommand == "validate":
+        if subcommand in ("validate", "test"):
             trainer.setup_state(init_params, initial_params=initial)
-            metrics = trainer.validate(dm.val_dataloader())
+            loader = dm.test_dataloader() if subcommand == "test" else dm.val_dataloader()
+            metrics = trainer.test(loader) if subcommand == "test" else trainer.validate(loader)
             trainer.close()
+            import json as _json
+
+            print(_json.dumps({k: round(float(v), 6) for k, v in metrics.items()}))
             return metrics
 
         state = trainer.fit(
@@ -360,9 +370,9 @@ class CLI:
         return state
 
     def _print_help(self) -> None:
-        print(f"usage: {self.family.name} {{fit|validate|preproc}} [--flag=value ...]")
+        print(f"usage: {self.family.name} {{fit|validate|test|preproc}} [--flag=value ...]")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
-              "--lr_scheduler.* --config=<yaml> --data=<name>")
+              "--lr_scheduler.* --config=<yaml> --data=<name> --ckpt=<dir>")
         print(f"data modules: {sorted(self.family.data_registry)}")
 
 

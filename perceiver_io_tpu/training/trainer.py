@@ -47,6 +47,7 @@ class TrainerConfig:
     val_check_interval: int = 1000
     log_every_n_steps: int = 50
     limit_val_batches: Optional[int] = None
+    limit_test_batches: Optional[int] = None
     default_root_dir: str = "logs"
     max_checkpoints: int = 1
     grad_clip_norm: Optional[float] = None
@@ -475,17 +476,24 @@ class Trainer:
 
     def validate(self, val_data: Iterable) -> dict:
         """Deterministic full pass over ``val_data``; returns mean metrics."""
+        return self._evaluate(val_data, self.config.limit_val_batches)
+
+    def test(self, test_data: Iterable) -> dict:
+        """Deterministic full pass over the test split; metrics keyed
+        ``test_*`` (reference ``LitClassifier.test_step`` sync-logs
+        ``test_loss``/``test_acc``, ``core/lightning.py:70-76``)."""
+        metrics = self._evaluate(test_data, self.config.limit_test_batches)
+        return {f"test_{k}": v for k, v in metrics.items()}
+
+    def _evaluate(self, data: Iterable, limit_batches: Optional[int]) -> dict:
         if self._eval_step is None:  # jit once; re-jitting per call would recompile
             self._eval_step = make_eval_step(self.loss_fn, self.mesh, self._shardings)
         eval_step = self._eval_step
         totals: dict = {}
         count = 0
         with self.mesh:
-            for i, batch in enumerate(val_data):
-                if (
-                    self.config.limit_val_batches is not None
-                    and i >= self.config.limit_val_batches
-                ):
+            for i, batch in enumerate(data):
+                if limit_batches is not None and i >= limit_batches:
                     break
                 metrics = eval_step(
                     self.state,

@@ -73,6 +73,7 @@ class ToyTextDataModule(ListDataModule):
         super().__init__(
             train_texts=texts,
             valid_texts=texts[:8],
+            test_texts=texts[8:16],
             dataset_dir=dataset_dir,
             **kwargs,
         )
@@ -108,6 +109,43 @@ def test_clm_cli_fit_and_validate(tmp_path):
 
     metrics = CLI(family).main(["validate", *argv])
     assert "loss" in metrics and np.isfinite(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_clm_cli_test_subcommand_with_ckpt(tmp_path):
+    """`test --ckpt <dir>` evaluates a saved model on the test split
+    (reference LightningCLI `test` + `--ckpt_path`)."""
+    import jax
+
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    family = _toy_family()
+    argv = [
+        "--data=toy",
+        f"--data.dataset_dir={tmp_path}/data",
+        "--data.max_seq_len=64",
+        "--data.batch_size=8",
+        "--model.max_latents=32",
+        "--model.num_channels=32",
+        "--model.num_heads=2",
+        "--model.num_self_attention_layers=1",
+        "--model.cross_attention_dropout=0.0",
+        "--trainer.max_steps=2",
+        "--trainer.val_check_interval=5",
+        f"--trainer.default_root_dir={tmp_path}/logs",
+        "--trainer.enable_checkpointing=false",
+        "--trainer.enable_tensorboard=false",
+    ]
+    state = CLI(family).main(["fit", *argv])
+    saved = tmp_path / "trained"
+    save_pretrained(str(saved), jax.device_get(state.params), None)
+
+    metrics = CLI(family).main(["test", *argv, f"--ckpt={saved}"])
+    assert "test_loss" in metrics and np.isfinite(metrics["test_loss"])
+
+    # The test split is deterministic: same ckpt, same metrics.
+    again = CLI(family).main(["test", *argv, f"--ckpt={saved}"])
+    assert again["test_loss"] == metrics["test_loss"]
 
 
 @pytest.mark.slow

@@ -25,7 +25,11 @@ def _run(family, argv, tmp_path):
     assert state is not None and int(state.step) == 3
     metrics = CLI(family).main(["validate", *argv])
     assert "loss" in metrics and np.isfinite(metrics["loss"])
-    return metrics
+    # test subcommand (reference LightningCLI fit/validate/test parity):
+    # every synthetic module materializes a test split by default.
+    test_metrics = CLI(family).main(["test", *argv])
+    assert "test_loss" in test_metrics and np.isfinite(test_metrics["test_loss"])
+    return {**metrics, **test_metrics}
 
 
 @pytest.mark.slow
@@ -47,7 +51,7 @@ def test_image_classifier_cli_synthetic(tmp_path):
         ],
         tmp_path,
     )
-    assert "accuracy" in metrics
+    assert "accuracy" in metrics and "test_accuracy" in metrics
 
 
 @pytest.mark.slow
@@ -113,4 +117,4 @@ def test_text_classifier_cli_synthetic(tmp_path):
         ],
         tmp_path,
     )
-    assert "accuracy" in metrics
+    assert "accuracy" in metrics and "test_accuracy" in metrics

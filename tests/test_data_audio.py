@@ -234,3 +234,22 @@ def test_prepare_data_rejects_overlapping_splits(tmp_path):
     dm = Leaky(str(tmp_path / "ds"), max_seq_len=32)
     with pytest.raises(ValueError, match="overlap"):
         dm.prepare_data()
+
+
+def test_stale_cache_with_different_split_signature_refused(tmp_path):
+    """A preproc cache built under one bucket layout must not silently serve
+    another (split membership would leak across train/test)."""
+    from perceiver_io_tpu.data.audio.symbolic import GiantMidiPianoDataModule
+
+    dm = GiantMidiPianoDataModule(dataset_dir=str(tmp_path), max_seq_len=32)
+    pre = dm.preproc_dir
+    pre.mkdir(parents=True)
+    import json
+
+    (pre / "split_manifest.json").write_text(json.dumps({"train": [], "valid": [], "_signature": ""}))
+    dm2 = GiantMidiPianoDataModule(dataset_dir=str(tmp_path), max_seq_len=32)
+    dm2.test_bucket = 3
+    with pytest.raises(ValueError, match="different .*split configuration"):
+        dm2.prepare_data()
+    # The default layout still accepts its own (pre-existing) cache.
+    dm.prepare_data()
