@@ -216,7 +216,7 @@ class WikipediaDataModule(_CarvedTestSplit, _HubDataModule):
         return self._carved_splits(texts, int(len(texts) * self.source_valid_size))
 
 
-class SyntheticTextDataModule(ListDataModule):
+class SyntheticTextDataModule(TextDataModule):
     """Deterministic synthetic corpus — offline smoke runs, CI, and config
     dry-runs (no reference counterpart: the reference cannot train without
     downloading a dataset).
@@ -252,8 +252,12 @@ class SyntheticTextDataModule(ListDataModule):
         self.corpus_seed = corpus_seed
         task = kwargs.get("task", "mlm")
         self._clf = (task if isinstance(task, str) else getattr(task, "name", "mlm")) == "clf"
-        super(ListDataModule, self).__init__(dataset_dir=dataset_dir, **kwargs)
+        super().__init__(dataset_dir=dataset_dir, **kwargs)
         self._num_classes = 2 if self._clf else None
+
+    @property
+    def num_classes(self):
+        return self._num_classes
 
     def preproc_dir_hash_input(self) -> str:
         return (

@@ -143,6 +143,13 @@ def _flash_eligible(q, k, v, dropout_rate) -> bool:
     # the materialized XLA softmax is cheap and the blockwise schedule's
     # per-block overhead can dominate — lets short self-attention use XLA
     # while long-kv cross-attention stays flash. Default 0 = flash everywhere.
+    #
+    # PROCESS-START-ONLY: this (and PERCEIVER_FLASH_BLOCKS in
+    # flash_attention.py) is read at trace time and is NOT part of the jit
+    # cache key — changing it in-process after a shape has compiled silently
+    # has no effect. Set it before the first forward pass; the tuning sweep
+    # (examples/perf/tune_step.py) isolates each setting in a subprocess for
+    # exactly this reason.
     import os
 
     try:

@@ -178,6 +178,22 @@ class ModelFamily:
     frozen_prefixes: Optional[Callable] = None  # (model_cfg) -> tuple of paths
 
 
+def _wants_help(argv: Sequence[str]) -> bool:
+    """True when a standalone ``-h``/``--help`` appears. Tokens consumed as
+    the *value* of a space-separated flag don't count: ``--data.text --help``
+    is a (strange) value, not a help request."""
+    expecting_value = False
+    for tok in argv:
+        if expecting_value:
+            expecting_value = False
+            continue
+        if tok in ("-h", "--help"):
+            return True
+        if tok.startswith("--") and "=" not in tok:
+            expecting_value = True
+    return False
+
+
 def _parse_dotted(argv: Sequence[str], known: Dict[str, Any]) -> Dict[str, Any]:
     values: Dict[str, Any] = {}
     i = 0
@@ -228,7 +244,7 @@ class CLI:
 
     def main(self, argv: Optional[Sequence[str]] = None) -> Any:
         argv = list(sys.argv[1:] if argv is None else argv)
-        if not argv or any(a in ("-h", "--help") for a in argv):
+        if not argv or _wants_help(argv):
             # help anywhere in argv (e.g. `fit --help`), like jsonargparse
             self._print_help()
             return None

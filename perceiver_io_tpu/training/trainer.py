@@ -93,6 +93,27 @@ _PROFILE_WINDOW = 3
 
 
 @jax.jit
+def _check_uniform_block(block, k_exec: int) -> None:
+    """Fused multi-step blocks np.stack ``k_exec`` batches — a user-supplied
+    iterable yielding ragged batches would otherwise die in an opaque
+    broadcast error deep inside tree_map. Built-in loaders use
+    ``drop_last=True``; arbitrary ``fit()`` iterables must match it."""
+    ref = block[0]
+    ref_structure = jax.tree_util.tree_structure(ref)
+    ref_shapes = [np.shape(leaf) for leaf in jax.tree_util.tree_leaves(ref)]
+    for i, b in enumerate(block[1:], 1):
+        structure = jax.tree_util.tree_structure(b)
+        shapes = [np.shape(leaf) for leaf in jax.tree_util.tree_leaves(b)]
+        if structure != ref_structure or shapes != ref_shapes:
+            raise ValueError(
+                f"steps_per_execution={k_exec} requires fixed-shape batches, "
+                f"but batch {i} of the block has leaves {shapes} vs the "
+                f"block's first batch {ref_shapes} — use a loader that drops "
+                "or pads the last partial batch (built-in loaders use "
+                "drop_last=True)"
+            )
+
+
 def _params_finite(params) -> jnp.ndarray:
     """Device-side all-finite reduction over a param tree (one fused pass;
     used to guard TrainState snapshots against persisting diverged state)."""
@@ -345,9 +366,9 @@ class Trainer:
                     cfg, step_idx, k_exec, val_data, resume_mgr
                 ):
                     # one device program for k_exec steps (amortized dispatch)
-                    stacked = jax.tree_util.tree_map(
-                        lambda *xs: np.stack(xs), *[next_batch() for _ in range(k_exec)]
-                    )
+                    block = [next_batch() for _ in range(k_exec)]
+                    _check_uniform_block(block, k_exec)
+                    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *block)
                     stacked = shard_or_assemble(
                         stacked, self.mesh, shard_seq=cfg.shard_seq, stacked_steps=True
                     )

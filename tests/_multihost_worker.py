@@ -55,6 +55,25 @@ def main() -> None:
     batch2 = shard_or_assemble({"x": local}, mesh)
     assert batch2["x"].shape == (2 * nproc, 3)
 
+    # Fused multi-step blocks on a pod: leaves carry a leading (n_steps, ...)
+    # dim; dim 1 is the per-host batch dim that gets assembled globally.
+    k_steps = 3
+    stacked_local = np.stack([local + 10.0 * s for s in range(k_steps)])
+    stacked = global_batch({"x": stacked_local}, mesh, stacked_steps=True)
+    assert stacked["x"].shape == (k_steps, 2 * nproc, 3), stacked["x"].shape
+    with mesh:
+        per_step = jax.jit(lambda x: jnp.sum(x, axis=(1, 2)))(stacked["x"])
+    per_step = np.asarray(per_step)
+    base = sum(
+        float((np.arange(6, dtype=np.float32) + 100.0 * p).sum()) for p in range(nproc)
+    )
+    for s in range(k_steps):
+        want = base + 10.0 * s * 6 * nproc  # +10/step on every element
+        assert float(per_step[s]) == want, (s, float(per_step[s]), want)
+
+    stacked2 = shard_or_assemble({"x": stacked_local}, mesh, stacked_steps=True)
+    assert stacked2["x"].shape == (k_steps, 2 * nproc, 3)
+
     print(f"MULTIHOST_OK {pid} {float(total)}", flush=True)
 
 
