@@ -92,7 +92,6 @@ class TrainerConfig:
 _PROFILE_WINDOW = 3
 
 
-@jax.jit
 def _check_uniform_block(block, k_exec: int) -> None:
     """Fused multi-step blocks np.stack ``k_exec`` batches — a user-supplied
     iterable yielding ragged batches would otherwise die in an opaque
@@ -114,6 +113,7 @@ def _check_uniform_block(block, k_exec: int) -> None:
             )
 
 
+@jax.jit
 def _params_finite(params) -> jnp.ndarray:
     """Device-side all-finite reduction over a param tree (one fused pass;
     used to guard TrainState snapshots against persisting diverged state)."""

@@ -179,3 +179,42 @@ def test_multi_step_composes_with_grad_accum():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
         jax.device_get(state.params), ref_params,
     )
+
+
+def test_ragged_block_raises_clear_error(tmp_path):
+    """A user iterable yielding a short last batch under
+    ``steps_per_execution>1`` must fail with the actual ``k_exec`` integer and
+    both shape lists in the message (not an opaque np.stack broadcast error,
+    and not a jit tracer repr — the check is host-side Python)."""
+    import pytest
+
+    model, cfg = tiny_clm()
+    prefix_len = SEQ - LATENTS
+
+    def init():
+        return model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32), prefix_len
+        )["params"]
+
+    good = _batches(1, batch_size=8)[0]
+    short = _batches(1, batch_size=5, seed=1)[0]  # ragged: 5 != 8
+
+    mesh = make_mesh(MeshConfig(data=1))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=2,
+            steps_per_execution=2,
+            enable_checkpointing=False,
+            enable_tensorboard=False,
+            default_root_dir=str(tmp_path),
+        ),
+        mesh,
+        clm_loss_fn(model, LATENTS),
+        optax.adam(1e-2),
+    )
+    with pytest.raises(ValueError) as excinfo:
+        trainer.fit(init, iter([good, short]))
+    msg = str(excinfo.value)
+    assert "steps_per_execution=2" in msg, msg  # the integer, not a tracer repr
+    assert str([(8, SEQ), (8, SEQ)]) in msg, msg
+    assert str([(5, SEQ), (5, SEQ)]) in msg, msg
