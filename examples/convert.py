@@ -29,6 +29,8 @@ loads with ``Perceiver<Task>.from_pretrained``:
 
     python examples/convert.py export clm trained_model_dir out_dir
     python examples/convert.py export mlm trained_model_dir out_dir
+    python examples/convert.py export clm trained_model_dir out_dir \
+        --push_to_hub --repo-id user/model   # needs network + HF token
 
 Key mappings live in ``perceiver_io_tpu/convert/`` (``torch_import`` for the
 reference layout, ``hf_import`` for transformers state dicts, ``export`` for
@@ -114,6 +116,19 @@ def export_main(argv) -> None:
     parser.add_argument("task", choices=["clm", "sam", "mlm", "img-clf", "flow", "txt-clf"])
     parser.add_argument("model_dir", help="save_pretrained dir or trainer checkpoint dir")
     parser.add_argument("out_dir")
+    # hub-publication surface, parity with the reference converter's
+    # ``--push_to_hub``/``--commit_message`` (reference examples/convert.py:70-89,
+    # which pushes each save_dir as a hub repo named after its basename)
+    parser.add_argument(
+        "--push_to_hub", "--push-to-hub", action="store_true",
+        help="after writing out_dir, upload it to the HF hub",
+    )
+    parser.add_argument(
+        "--repo-id", "--repo_id", default=None,
+        help="hub repo id for --push_to_hub (default: basename of out_dir, "
+        "matching the reference's save_dir-as-repo-name convention)",
+    )
+    parser.add_argument("--commit_message", "--commit-message", default=None)
     args = parser.parse_args(argv)
 
     import perceiver_io_tpu.convert as convert
@@ -124,6 +139,38 @@ def export_main(argv) -> None:
         raise SystemExit(f"{args.model_dir} carries no model config; cannot export")
     convert.save_reference_checkpoint(params, cfg, args.out_dir, args.task)
     print(f"exported {args.task} model to reference format at {args.out_dir}")
+    if args.push_to_hub:
+        _push_to_hub(args.out_dir, args.repo_id, args.commit_message)
+
+
+def _push_to_hub(out_dir: str, repo_id, commit_message) -> None:
+    """Upload an exported artifact dir to the HF hub. Fails with a clear
+    message when huggingface_hub is unavailable, no token is configured, or
+    the network is unreachable (e.g. a zero-egress sandbox)."""
+    if repo_id is None:
+        repo_id = os.path.basename(os.path.normpath(out_dir))
+    try:
+        from huggingface_hub import HfApi
+    except ImportError:
+        raise SystemExit(
+            "--push_to_hub requires the huggingface_hub package "
+            "(pip install huggingface_hub)"
+        )
+    api = HfApi()
+    try:
+        api.create_repo(repo_id, exist_ok=True)
+        api.upload_folder(
+            repo_id=repo_id,
+            folder_path=out_dir,
+            commit_message=commit_message or f"Upload {repo_id}",
+        )
+    except Exception as e:  # hub/network/auth errors all surface identically
+        raise SystemExit(
+            f"--push_to_hub failed for repo '{repo_id}': {e}\n"
+            f"The exported artifact is intact at {out_dir}; push it later with "
+            "huggingface-cli upload, or re-run with network + HF_TOKEN available."
+        )
+    print(f"pushed {out_dir} to hub repo {repo_id}")
 
 
 def main() -> None:

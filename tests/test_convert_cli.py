@@ -15,7 +15,10 @@ torch = pytest.importorskip("torch")
 from tests._reference import load_reference  # noqa: E402
 
 ref = load_reference()
-pytestmark = pytest.mark.skipif(ref is None, reason="reference tree not available")
+pytestmark = [
+    pytest.mark.skipif(ref is None, reason="reference tree not available"),
+    pytest.mark.slow,  # each test subprocess-spawns python importing torch+jax
+]
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
 
@@ -109,3 +112,48 @@ def test_convert_cli_export_roundtrip(tmp_path):
         want = t_model(torch.tensor(ids), prefix_len=5).numpy()
         got = fresh(torch.tensor(ids), prefix_len=5).numpy()
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_convert_cli_export_push_to_hub_errors_cleanly(tmp_path):
+    """--push_to_hub (reference examples/convert.py:70-89 parity surface) must
+    fail with an actionable message in an offline sandbox — and leave the
+    exported artifact intact."""
+    kw = dict(
+        vocab_size=262, max_seq_len=16, max_latents=8, num_channels=16,
+        num_self_attention_layers=1, init_scale=0.1,
+    )
+    t_model = ref.clm.CausalLanguageModel(ref.clm.CausalLanguageModelConfig(**kw)).eval()
+    ckpt = tmp_path / "ckpt.ckpt"
+    torch.save(
+        {"state_dict": {f"model.{k}": v for k, v in t_model.state_dict().items()}},
+        ckpt,
+    )
+    imported = tmp_path / "imported"
+    proc = subprocess.run(
+        [
+            sys.executable, "examples/convert.py", "clm", str(ckpt), str(imported),
+            "--vocab-size", "262", "--max-seq-len", "16", "--max-latents", "8",
+            "--num-channels", "16", "--num-layers", "1",
+        ],
+        capture_output=True, text=True, cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    import os
+
+    exported = tmp_path / "exported"
+    env = dict(os.environ, HF_HUB_OFFLINE="1")  # deterministic fast failure
+    proc = subprocess.run(
+        [
+            sys.executable, "examples/convert.py", "export", "clm",
+            str(imported), str(exported),
+            "--push_to_hub", "--repo-id", "someone/some-model",
+        ],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), env=env,
+    )
+    assert proc.returncode != 0
+    assert "--push_to_hub failed for repo 'someone/some-model'" in proc.stderr, proc.stderr
+    assert "artifact is intact" in proc.stderr
+    # the export itself succeeded before the push attempt
+    assert (exported / "pytorch_model.bin").exists()
+    assert (exported / "config.json").exists()

@@ -358,3 +358,58 @@ def test_save_reference_checkpoint_mlm_config_fields(tmp_path):
     sd = torch.load(os.path.join(save_dir, "pytorch_model.bin"), weights_only=True)
     stripped = {k.removeprefix("backend_model."): v for k, v in sd.items()}
     t_model.load_state_dict(stripped, strict=True)
+
+
+@pytest.mark.slow
+def test_img_clf_train_then_export_logits_parity():
+    """Train-then-export parity for an encoder/decoder family (VERDICT r4
+    ask #7): import → one optimizer step on the Fourier-adapter image
+    classifier in JAX → export → reference torch forward matches at 1e-4.
+    Proves the export path under trained (not just initialized) weights for
+    the Fourier position adapter + classification decoder."""
+    torch.manual_seed(7)
+    from perceiver_io_tpu.models.vision.image_classifier import ImageClassifier
+
+    enc_kw = dict(
+        image_shape=(8, 8, 1), num_frequency_bands=4, num_cross_attention_heads=1,
+        num_self_attention_heads=2, num_self_attention_layers_per_block=2,
+    )
+    clf_dec = dict(num_classes=2, num_output_query_channels=16, num_cross_attention_heads=1)
+    t_model = ref.img_clf.ImageClassifier(
+        ref.img_clf.ImageClassifierConfig(
+            encoder=ref.img_clf.ImageEncoderConfig(**enc_kw),
+            decoder=ref.core_config.ClassificationDecoderConfig(**clf_dec),
+            num_latents=4, num_latent_channels=16,
+        )
+    ).eval()
+    j_config = PerceiverIOConfig(
+        encoder=ImageEncoderConfig(**enc_kw),
+        decoder=ClassificationDecoderConfig(**clf_dec),
+        num_latents=4, num_latent_channels=16,
+    )
+    j_model = ImageClassifier(config=j_config)
+    params = convert.import_image_classifier(t_model.state_dict(), j_config)
+
+    rng = np.random.default_rng(2)
+    imgs = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, 2, (2,)))
+
+    def grad_fn(p):
+        def loss(p):
+            logits = j_model.apply({"params": p}, jnp.asarray(imgs))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        return jax.grad(loss)(p)
+
+    params = _train_one_step(j_model, params, grad_fn)
+
+    out = convert.export_image_classifier(params, j_config)
+    t_model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in out.items()}, strict=True
+    )
+    with torch.no_grad():
+        t_logits = t_model(torch.tensor(imgs))
+    j_logits = j_model.apply({"params": params}, jnp.asarray(imgs))
+    np.testing.assert_allclose(
+        np.asarray(j_logits, np.float32), t_logits.numpy(), atol=1e-4, rtol=1e-4
+    )

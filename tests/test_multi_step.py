@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
 from perceiver_io_tpu.parallel import (
@@ -181,13 +182,12 @@ def test_multi_step_composes_with_grad_accum():
     )
 
 
+@pytest.mark.slow
 def test_ragged_block_raises_clear_error(tmp_path):
     """A user iterable yielding a short last batch under
     ``steps_per_execution>1`` must fail with the actual ``k_exec`` integer and
     both shape lists in the message (not an opaque np.stack broadcast error,
     and not a jit tracer repr — the check is host-side Python)."""
-    import pytest
-
     model, cfg = tiny_clm()
     prefix_len = SEQ - LATENTS
 
