@@ -1,0 +1,125 @@
+"""Cached vs recompute decode throughput as context length grows.
+
+The KV cache's claim (``inference/generate.py:27-53``): in the prefix-growth
+phase the cached step elides the full-window embedding + cross-k/v projections
+— the ``2·n·c²`` matmuls — while the recompute path pays them every token.
+Under the static right-aligned window formulation both paths' per-token cost
+is a function of the *window* size ``n = max_seq_len`` (left pads are computed
+and masked), so the claim's scaling axis is context length, not prompt
+length: the cached/recompute ratio must grow with ``n``.
+
+This script measures both paths at a fixed small model (CPU-feasible; pass
+``--tpu`` to run on the default accelerator backend at deployment bf16) over
+a sweep of context lengths, prints one JSON line per point, and a markdown
+table suitable for ``docs/benchmarks.md``.
+
+Usage::
+
+    python examples/perf/decode_scaling.py                  # CPU, 1k->8k
+    python examples/perf/decode_scaling.py --ctxs 1024 2048 # subset
+    python examples/perf/decode_scaling.py --tpu            # real chip
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ctxs", type=int, nargs="+", default=[1024, 2048, 4096, 8192])
+    p.add_argument("--num-latents", type=int, default=512)
+    p.add_argument("--num-channels", type=int, default=256)
+    p.add_argument("--num-layers", type=int, default=4)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--new-tokens", type=int, default=8)
+    p.add_argument("--tpu", action="store_true",
+                   help="run on the default accelerator backend (else force CPU)")
+    p.add_argument("--out", default=None, help="also append JSON lines here")
+    args = p.parse_args()
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+    from perceiver_io_tpu.models.text.clm import (
+        CausalLanguageModel,
+        CausalLanguageModelConfig,
+    )
+
+    platform = jax.default_backend()
+    rows = []
+    for ctx in args.ctxs:
+        cfg = CausalLanguageModelConfig(
+            vocab_size=262,
+            max_seq_len=ctx,
+            max_latents=args.num_latents,
+            num_channels=args.num_channels,
+            num_heads=args.num_heads,
+            num_self_attention_layers=args.num_layers,
+        )
+        model = CausalLanguageModel(cfg, dtype=jnp.bfloat16 if args.tpu else None)
+        rng = np.random.default_rng(0)
+        prefix_len = ctx - args.num_latents
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, ctx), jnp.int32), prefix_len
+        )["params"]
+        if args.tpu:
+            params = cast_float_params(params, jnp.bfloat16)
+
+        # Prompt fills the window up to the last new_tokens positions: every
+        # generated token lands in the prefix-growth phase — the phase the
+        # 2nc^2-elision claim is about (generate.py:33-43). Latents are
+        # already at max (num_latents=cfg.max_latents in the config below).
+        prompt_len = ctx - args.new_tokens
+        prompt = jnp.asarray(
+            rng.integers(1, cfg.vocab_size, size=(args.batch, prompt_len), dtype=np.int32)
+        )
+        gcfg = GenerationConfig(
+            max_new_tokens=args.new_tokens, num_latents=args.num_latents
+        )
+
+        point = {"ctx": ctx, "platform": platform, "batch": args.batch,
+                 "new_tokens": args.new_tokens, "channels": args.num_channels,
+                 "layers": args.num_layers, "num_latents": args.num_latents}
+        for label, use_cache in (("cached", True), ("recompute", False)):
+            ids = generate(model, params, prompt, gcfg, use_cache=use_cache)
+            _ = int(np.asarray(jax.device_get(ids))[0, -1])  # warm + fence
+            t0 = time.perf_counter()
+            ids = generate(model, params, prompt, gcfg, use_cache=use_cache)
+            _ = int(np.asarray(jax.device_get(ids))[0, -1])
+            dt = time.perf_counter() - t0
+            point[f"{label}_tokens_per_sec"] = round(
+                args.batch * args.new_tokens / dt, 2)
+            point[f"{label}_ms_per_token"] = round(dt / args.new_tokens * 1e3, 2)
+        point["speedup"] = round(
+            point["cached_tokens_per_sec"] / point["recompute_tokens_per_sec"], 2
+        )
+        rows.append(point)
+        print(json.dumps(point), flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(json.dumps(point) + "\n")
+
+    print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['ctx']} | {r['cached_tokens_per_sec']} | "
+              f"{r['recompute_tokens_per_sec']} | {r['cached_ms_per_token']} | "
+              f"{r['recompute_ms_per_token']} | {r['speedup']}x |")
+
+
+if __name__ == "__main__":
+    main()

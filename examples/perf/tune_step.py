@@ -50,6 +50,15 @@ SWEEP = [
         "env": {"PERCEIVER_FLASH_BLOCKS": "1024,512,256,128", "PERCEIVER_FLASH_MIN_KV": "2048"},
     },
     {"name": "xla", "impl": "xla", "env": {}},
+    # Fused same-input projections (modules.py:_fused_dense): one wider
+    # matmul for self-attn q/k/v and cross-attn k/v. Exactness-tested on CPU
+    # (tests/test_fused_qkv.py); throughput effect is measured here.
+    {"name": "flash-fusedqkv", "impl": "auto", "env": {"PERCEIVER_FUSED_QKV": "1"}},
+    {
+        "name": "flash-fusedqkv-minkv2048",
+        "impl": "auto",
+        "env": {"PERCEIVER_FUSED_QKV": "1", "PERCEIVER_FLASH_MIN_KV": "2048"},
+    },
 ]
 
 

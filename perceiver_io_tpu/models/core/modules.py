@@ -52,6 +52,18 @@ def _layer_norm(dtype, name: str) -> nn.LayerNorm:
     return nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=dtype, name=name, use_fast_variance=False)
 
 
+def _fused_qkv() -> bool:
+    """``PERCEIVER_FUSED_QKV=1`` merges same-input q/k/v (self-attention) and
+    k/v (cross-attention) projections into single wider matmuls. Like the
+    ``PERCEIVER_FLASH_*`` knobs this is read at trace time and is NOT part of
+    the jit cache key — set it before the first forward pass (the tuning
+    sweep isolates each setting in a subprocess). Default off until measured
+    on hardware; exactness vs the unfused path is tested either way."""
+    import os
+
+    return os.environ.get("PERCEIVER_FUSED_QKV", "0") == "1"
+
+
 def _remat_policy(offload: bool):
     """Remat saving policy for activation checkpointing. ``offload=False``
     saves nothing (pure rematerialization). ``offload=True`` is the TPU-native
@@ -120,12 +132,26 @@ class MultiHeadAttention(nn.Module):
     def project_q(self, x_q: jnp.ndarray, rot_pos_emb: Optional[RotaryEmbedding] = None) -> jnp.ndarray:
         """(b, n, Dq) -> scaled + rotated (b, h, n, ck). Exposed for the
         KV-cache decode loop."""
+        return self._finish_q(self.q_proj(x_q), rot_pos_emb)
+
+    def _finish_q(
+        self, q_flat: jnp.ndarray, rot_pos_emb: Optional[RotaryEmbedding]
+    ) -> jnp.ndarray:
+        """Shared post-projection q path (fused and unfused): split heads,
+        scale, then rotate — the reference's order of operations."""
         qk, _, _ = self._channels()
-        q = self._split_heads(self.q_proj(x_q))
-        q = q * ((qk // self.num_heads) ** -0.5)
+        q = self._split_heads(q_flat) * ((qk // self.num_heads) ** -0.5)
         if rot_pos_emb is not None:
             q = rot_pos_emb.rotate(q)
         return q
+
+    def _finish_k(
+        self, k_flat: jnp.ndarray, rot_pos_emb: Optional[RotaryEmbedding]
+    ) -> jnp.ndarray:
+        k = self._split_heads(k_flat)
+        if rot_pos_emb is not None:
+            k = rot_pos_emb.rotate(k)
+        return k
 
     def project_kv(
         self, x_kv: jnp.ndarray, rot_pos_emb: Optional[RotaryEmbedding] = None
@@ -133,11 +159,34 @@ class MultiHeadAttention(nn.Module):
         """(b, n, Dkv) -> rotated (b, h, n, ck), (b, h, n, cv). Exposed for
         the KV-cache decode loop (keys are cached post-rotation; rotary is
         relative so a global position offset cancels in attention scores)."""
-        k = self._split_heads(self.k_proj(x_kv))
-        v = self._split_heads(self.v_proj(x_kv))
-        if rot_pos_emb is not None:
-            k = rot_pos_emb.rotate(k)
-        return k, v
+        if _fused_qkv() and not self.is_initializing():
+            # One (n, Dkv) x (Dkv, ck+cv) matmul instead of two: k and v
+            # always project from the same (often window-length) input, and
+            # a single wider matmul keeps the MXU busier per dispatch. The
+            # param tree is untouched — kernels are concatenated at trace
+            # time and XLA hoists the concat out of the step as a constant
+            # when params are donated. Mathematically identical to the
+            # separate projections (same per-element dot products).
+            kv = self._fused_dense((self.k_proj, self.v_proj), x_kv)
+            qk, _, _ = self._channels()
+            k_flat, v_flat = kv[..., :qk], kv[..., qk:]
+        else:
+            k_flat, v_flat = self.k_proj(x_kv), self.v_proj(x_kv)
+        return self._finish_k(k_flat, rot_pos_emb), self._split_heads(v_flat)
+
+    def _fused_dense(self, projs, x: jnp.ndarray) -> jnp.ndarray:
+        """Apply several same-input Dense submodules as one matmul over their
+        output-axis-concatenated kernels (numerics preserved: computation
+        dtype and bias handling mirror ``nn.Dense``)."""
+        ws = [p.variables["params"]["kernel"] for p in projs]
+        w = jnp.concatenate([jnp.asarray(w, self.dtype) for w in ws], axis=1)
+        out = jnp.dot(x.astype(self.dtype), w)
+        if self.qkv_bias:
+            bs = [p.variables["params"]["bias"] for p in projs]
+            out = out + jnp.concatenate(
+                [jnp.asarray(b, self.dtype) for b in bs], axis=0
+            )
+        return out
 
     def attend(
         self,
@@ -173,6 +222,17 @@ class MultiHeadAttention(nn.Module):
         rot_pos_emb_k: Optional[RotaryEmbedding] = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
+        if (
+            _fused_qkv()
+            and x_q is x_kv  # self-attention: one source feeds q, k and v
+            and not self.is_initializing()
+        ):
+            qk, _, _ = self._channels()
+            qkv = self._fused_dense((self.q_proj, self.k_proj, self.v_proj), x_q)
+            q = self._finish_q(qkv[..., :qk], rot_pos_emb_q)
+            k = self._finish_k(qkv[..., qk:2 * qk], rot_pos_emb_k)
+            v = self._split_heads(qkv[..., 2 * qk:])
+            return self.attend(q, k, v, pad_mask=pad_mask, deterministic=deterministic)
         q = self.project_q(x_q, rot_pos_emb_q)
         k, v = self.project_kv(x_kv, rot_pos_emb_k)
         return self.attend(q, k, v, pad_mask=pad_mask, deterministic=deterministic)
