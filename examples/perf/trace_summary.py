@@ -1,0 +1,102 @@
+"""Summarize a jax.profiler xplane trace: per-kernel time decomposition.
+
+Reads the ``*.xplane.pb`` a ``jax.profiler.trace`` capture writes (e.g. from
+``tune_step.py --trace flash-default`` or the trainer's ``profile_start``
+window) and prints, per device plane, the top ops by accumulated duration
+with their share of total device-busy time — the decomposition needed to
+attribute the gap between achieved and peak MFU to specific kernels
+(docs/benchmarks.md "vs the north star").
+
+No tensorboard involved: the XSpace protobuf is parsed directly via the
+``xplane_pb2`` module bundled with the baked-in tensorflow wheel.
+
+Usage::
+
+    python examples/perf/trace_summary.py <trace_dir_or_xplane.pb> [--top 25]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+from collections import defaultdict
+
+
+def find_xplane(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise SystemExit(f"no *.xplane.pb under {path}")
+    return hits[-1]  # latest capture
+
+
+def load_xspace(pb_path: str):
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:
+        raise SystemExit(
+            f"xplane_pb2 unavailable ({e}); install tensorflow or inspect the "
+            "trace with tensorboard's profile plugin instead"
+        )
+    space = xplane_pb2.XSpace()
+    with open(pb_path, "rb") as f:
+        space.ParseFromString(f.read())
+    return space
+
+
+def summarize_plane(plane, top: int) -> None:
+    """Aggregate per LINE, not per plane: a plane's lines overlap in time
+    (e.g. an 'XLA Modules' line whose one envelope event spans every kernel
+    on the 'XLA Ops' line), so mixing lines would double-count and distort
+    the per-op percentages. Within a line events are siblings on one
+    timeline and their shares are meaningful."""
+    meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+    for line in plane.lines:
+        totals = defaultdict(int)  # name -> ps
+        counts = defaultdict(int)
+        span_lo, span_hi = None, 0
+        for ev in line.events:
+            name = meta.get(ev.metadata_id, f"#{ev.metadata_id}")
+            totals[name] += ev.duration_ps
+            counts[name] += 1
+            lo = line.timestamp_ns * 1000 + ev.offset_ps
+            span_lo = lo if span_lo is None else min(span_lo, lo)
+            span_hi = max(span_hi, lo + ev.duration_ps)
+        if not totals:
+            continue
+        busy_ps = sum(totals.values())
+        span_ms = (span_hi - (span_lo or 0)) / 1e9
+        print(f"\n== plane: {plane.name} | line: {line.name or line.id}  "
+              f"(span={span_ms:.2f} ms, busy={busy_ps / 1e9:.2f} ms) ==")
+        print(f"{'ms':>10} {'%busy':>6} {'calls':>6}  op")
+        for name, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"{ps / 1e9:10.3f} {100 * ps / busy_ps:6.1f} {counts[name]:6d}  "
+                  f"{name[:110]}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="trace dir or .xplane.pb file")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--all-planes", action="store_true",
+                    help="include host/python planes (default: device planes "
+                    "only, falling back to all when none found)")
+    args = ap.parse_args()
+
+    pb = find_xplane(args.path)
+    print(f"trace: {pb}", file=sys.stderr)
+    space = load_xspace(pb)
+
+    device_planes = [
+        p for p in space.planes
+        if "TPU" in p.name or "GPU" in p.name or p.name.startswith("/device")
+    ]
+    planes = list(space.planes) if args.all_planes or not device_planes else device_planes
+    for plane in planes:
+        summarize_plane(plane, args.top)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,10 +59,28 @@ SWEEP = [
         "impl": "auto",
         "env": {"PERCEIVER_FUSED_QKV": "1", "PERCEIVER_FLASH_MIN_KV": "2048"},
     },
+    # Latency-hiding scheduler: overlaps collective/memory traffic with
+    # compute at the XLA schedule level — a pure-flags candidate for the
+    # ~20%-MFU dense blocks (appended to ambient XLA_FLAGS by run_one).
+    {
+        "name": "flash-lhs",
+        "impl": "auto",
+        "env": {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"},
+    },
+    {
+        "name": "flash-fusedqkv-lhs",
+        "impl": "auto",
+        "env": {
+            "PERCEIVER_FUSED_QKV": "1",
+            "XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true",
+        },
+    },
 ]
 
 
-def child(shape, impl: str) -> None:
+def child(shape, impl: str, trace_dir: str | None = None) -> None:
+    import contextlib
+
     import jax
     import numpy as np
 
@@ -76,15 +94,31 @@ def child(shape, impl: str) -> None:
     with mesh:
         sharded = shard_batch({"input_ids": ids[:, :-1], "labels": ids[:, 1:]}, mesh)
         _, state, step, _ = bench._build_ar(cfg, mesh, impl)
-        chained_ms, synced_ms, _, loss = bench._time_train(
+        # When tracing, capture the already-warm chained window only: the
+        # xplane then contains just N identical steady-state steps — the
+        # per-kernel decomposition the MFU analysis needs.
+        ctx = (
+            jax.profiler.trace(trace_dir)
+            if trace_dir is not None
+            else contextlib.nullcontext()
+        )
+        chained_ms, synced_ms, state, loss = bench._time_train(
             step, state, sharded, jax.random.PRNGKey(1), n_chain=20, n_sync=2
         )
-    print(json.dumps({
+        if trace_dir is not None:
+            with ctx:
+                for i in range(3):
+                    state, metrics = step(state, sharded, jax.random.fold_in(jax.random.PRNGKey(3), i))
+                bench._fetch(metrics["loss"])
+    out = {
         "chained_ms": round(chained_ms, 2),
         "synced_ms": round(synced_ms, 2),
         "loss": round(loss, 4),
         "tokens_per_sec": round(batch_size * cfg.max_seq_len / (chained_ms / 1e3), 1),
-    }), flush=True)
+    }
+    if trace_dir is not None:
+        out["trace_dir"] = trace_dir
+    print(json.dumps(out), flush=True)
 
 
 def ceiling_child() -> None:
@@ -92,9 +126,19 @@ def ceiling_child() -> None:
 
 
 def run_one(args_list, env_extra, timeout_s):
-    # Start from an env with every PERCEIVER_FLASH_* knob stripped: configs
-    # must see exactly the knobs they declare, not leftovers from the shell.
-    env = {k: v for k, v in os.environ.items() if not k.startswith("PERCEIVER_FLASH_")}
+    # Start from an env with every perf knob stripped: configs must see
+    # exactly the knobs they declare, not leftovers from the shell.
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("PERCEIVER_FLASH_") and k != "PERCEIVER_FUSED_QKV"
+    }
+    # XLA_FLAGS entries append to (not replace) the ambient flags — the host
+    # may carry required platform flags.
+    if "XLA_FLAGS" in env_extra:
+        env_extra = dict(env_extra)
+        env_extra["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") + " " + env_extra["XLA_FLAGS"]
+        ).strip()
     # shared XLA disk cache: identical programs across sweep configs (e.g.
     # the xla attention path under different env knobs) compile once
     env.setdefault(
@@ -129,9 +173,32 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--out", default=None)
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument(
+        "--trace", default=None, metavar="NAME",
+        help="run only the named sweep config with a jax.profiler device "
+        "trace of 3 steady-state steps (xplane written under "
+        "<out dir>/trace-NAME) — the per-kernel decomposition for MFU "
+        "analysis",
+    )
     args = ap.parse_args()
     shape = QUICK_SHAPE if args.quick else FULL_SHAPE
     shape_arg = ",".join(map(str, shape))
+
+    if args.trace is not None:
+        cfg = next((c for c in SWEEP if c["name"] == args.trace), None)
+        if cfg is None:
+            raise SystemExit(
+                f"unknown config {args.trace!r}; choose from "
+                f"{[c['name'] for c in SWEEP]}"
+            )
+        trace_dir = os.path.abspath(
+            os.path.join(os.path.dirname(args.out or "."), f"trace-{cfg['name']}")
+        )
+        r = run_one(
+            ["--child", shape_arg, cfg["impl"], trace_dir], cfg["env"], args.timeout
+        )
+        print(json.dumps({"shape": list(shape), "trace": r}))
+        return
 
     results = {"shape": list(shape), "configs": {}}
     print(f"[tune] matmul ceiling...", file=sys.stderr, flush=True)
@@ -152,7 +219,11 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child(tuple(int(x) for x in sys.argv[2].split(",")), sys.argv[3])
+        child(
+            tuple(int(x) for x in sys.argv[2].split(",")),
+            sys.argv[3],
+            trace_dir=sys.argv[4] if len(sys.argv) > 4 else None,
+        )
     elif len(sys.argv) > 1 and sys.argv[1] == "--ceiling":
         ceiling_child()
     else:

@@ -83,6 +83,17 @@ def child_env(args) -> dict:
         for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
             env.pop(var, None)
         env["JAX_PLATFORMS"] = "cpu"
+    # A leaked virtual-device-count flag (e.g. from the test suite's
+    # conftest) would give the CLI an N-device mesh the tiny batch cannot
+    # shard over — this run is a single-device evidence run either way.
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
     return env
 
 
@@ -128,13 +139,15 @@ def latest_step(metrics_path: str) -> int:
 
 def markov_entropy_floor(corpus_seed: int = 0) -> float:
     """Conditional entropy (nats/char) of the synthetic corpus's Markov
-    source — same construction as SyntheticTextDataModule.load_source_dataset
-    (data/text/sources.py): dirichlet(0.3) rows over a 27-char alphabet."""
+    source. The transition matrix comes from the SAME function the
+    datamodule draws it from (``sources.markov_transition``, first draw of
+    ``default_rng(corpus_seed)``), so this floor cannot silently diverge
+    from the corpus construction."""
     import numpy as np
 
-    rng = np.random.default_rng(corpus_seed)
-    k = 27
-    trans = rng.dirichlet(np.full(k, 0.3), size=k)
+    from perceiver_io_tpu.data.text.sources import markov_transition
+
+    trans = markov_transition(np.random.default_rng(corpus_seed))
     # stationary distribution: left eigenvector of the transition matrix
     evals, evecs = np.linalg.eig(trans.T)
     pi = np.real(evecs[:, np.argmax(np.real(evals))])
@@ -184,9 +197,12 @@ def analyze(args, events: list) -> dict:
     final_train = train[-1][1]
     final_val = val[-1][1] if val else None
     # 2. sanity: the CE floor is never crossed (which would mean leakage or a
-    # loss bug, not learning); closeness to the floor is reported, not gated
+    # loss bug, not learning); closeness to the floor is reported, not gated.
+    # Slack 0.05 nats: each logged loss is a finite-batch mean (~10k tokens
+    # per flush window → std ~0.015 nats), so a converged run's min-of-tail
+    # can dip slightly below the asymptotic floor by sampling noise.
     tail = [l for _, l in train[-10:]]
-    assert min(tail) >= floor - 1e-3, f"loss {min(tail)} below entropy floor {floor}"
+    assert min(tail) >= floor - 0.05, f"loss {min(tail)} below entropy floor {floor}"
 
     with open(os.path.join(args.root, "curve.csv"), "w") as f:
         f.write("step,train_loss\n")
@@ -246,6 +262,14 @@ def main() -> None:
                    "forcing CPU children")
     args = p.parse_args()
 
+    # Replay-equality at the SIGKILL seam compares window-averaged losses,
+    # which only line up when resume points land on log boundaries.
+    if args.snap_every % args.log_every:
+        raise SystemExit(
+            f"--snap-every ({args.snap_every}) must be a multiple of "
+            f"--log-every ({args.log_every}) so resumed flush windows align "
+            "with the killed run's for the replay-equality check"
+        )
     os.makedirs(args.root, exist_ok=True)
     events: list = []
 

@@ -216,6 +216,17 @@ class WikipediaDataModule(_CarvedTestSplit, _HubDataModule):
         return self._carved_splits(texts, int(len(texts) * self.source_valid_size))
 
 
+def markov_transition(rng) -> "np.ndarray":
+    """The synthetic corpus's order-1 Markov transition matrix — the FIRST
+    draw from the corpus rng (``default_rng(corpus_seed)``). Shared with the
+    entropy-floor oracle (``examples/training/longrun.py``) so the floor can
+    never silently diverge from the data it bounds: rows are
+    ``dirichlet(0.3)`` over the 27-char alphabet (peaked → entropy well
+    below uniform)."""
+    k = len(SyntheticTextDataModule._ALPHABET)
+    return rng.dirichlet(np.full(k, 0.3), size=k)
+
+
 class SyntheticTextDataModule(TextDataModule):
     """Deterministic synthetic corpus — offline smoke runs, CI, and config
     dry-runs (no reference counterpart: the reference cannot train without
@@ -288,8 +299,8 @@ class SyntheticTextDataModule(TextDataModule):
                 out["test"] = split(self.num_test_docs)
             return out
 
-        k = len(self._ALPHABET)
-        trans = rng.dirichlet(np.full(k, 0.3), size=k)  # peaked rows
+        trans = markov_transition(rng)
+        k = trans.shape[0]
 
         def doc():
             states = np.empty(self.doc_chars, np.int64)

@@ -163,10 +163,12 @@ class MultiHeadAttention(nn.Module):
             # One (n, Dkv) x (Dkv, ck+cv) matmul instead of two: k and v
             # always project from the same (often window-length) input, and
             # a single wider matmul keeps the MXU busier per dispatch. The
-            # param tree is untouched — kernels are concatenated at trace
-            # time and XLA hoists the concat out of the step as a constant
-            # when params are donated. Mathematically identical to the
-            # separate projections (same per-element dot products).
+            # param tree is untouched; the concat of the (loop-varying)
+            # kernels re-executes every step — ~2D² extra HBM traffic per
+            # layer against the n·D-dominated matmul reads, negligible for
+            # n >> D but part of what the sweep measures. Mathematically
+            # identical to the separate projections (same per-element dot
+            # products).
             kv = self._fused_dense((self.k_proj, self.v_proj), x_kv)
             qk, _, _ = self._channels()
             k_flat, v_flat = kv[..., :qk], kv[..., qk:]

@@ -1,0 +1,43 @@
+"""Sustained-run orchestrator smoke (examples/training/longrun.py): the
+three-phase SIGTERM/SIGKILL/complete flow over the real family CLI must
+produce a continuous, replay-consistent metrics trail and a summary whose
+entropy-floor bookkeeping holds. Tiny config; the full-size evidence run is
+documented in docs/training-examples.md."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.mark.slow
+def test_longrun_orchestrator_smoke(tmp_path):
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [
+            sys.executable, "examples/training/longrun.py",
+            "--root", str(tmp_path),
+            "--max-steps", "60", "--kill1", "20", "--kill2", "43",
+            "--batch", "2", "--seq", "128", "--latents", "64",
+            "--channels", "64", "--layers", "2",
+            "--train-docs", "16", "--doc-chars", "2048",
+            "--val-every", "20", "--log-every", "5", "--snap-every", "10",
+        ],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), env=env,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    assert summary["max_steps"] == 60
+    assert summary["final_train_loss"] >= summary["entropy_floor_nats"]
+    # three phases: SIGTERM exit (rc 0), SIGKILL exit (rc -9), clean finish
+    rcs = [e["rc"] for e in summary["events"] if "rc" in e]
+    assert rcs[0] == 0 and rcs[1] == -9 and rcs[2] == 0, rcs
+    assert (tmp_path / "curve.csv").exists()
+    curve = (tmp_path / "curve.csv").read_text().strip().splitlines()
+    assert curve[0] == "step,train_loss" and len(curve) > 5
