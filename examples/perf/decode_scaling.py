@@ -41,6 +41,14 @@ def main() -> None:
     p.add_argument("--new-tokens", type=int, default=8)
     p.add_argument("--tpu", action="store_true",
                    help="run on the default accelerator backend (else force CPU)")
+    p.add_argument(
+        "--phase", choices=["boundary", "latent"], default="boundary",
+        help="which cache phase every generated token lands in: 'boundary' "
+        "(prefix-growth — latents already maxed; the cache elides only the "
+        "full-window embedding + cross-k/v projections) or 'latent' "
+        "(latent-growth — the cache runs O(1) tokens of compute per step "
+        "vs the recompute path's full window)",
+    )
     p.add_argument("--out", default=None, help="also append JSON lines here")
     args = p.parse_args()
 
@@ -79,19 +87,25 @@ def main() -> None:
         if args.tpu:
             params = cast_float_params(params, jnp.bfloat16)
 
-        # Prompt fills the window up to the last new_tokens positions: every
-        # generated token lands in the prefix-growth phase — the phase the
-        # 2nc^2-elision claim is about (generate.py:33-43). Latents are
-        # already at max (num_latents=cfg.max_latents in the config below).
+        # Both phases keep the prompt near the window so the recompute path
+        # always pays the full (b, ctx) forward. 'boundary': latents start
+        # at max, every token migrates the prefix boundary. 'latent':
+        # latents start low enough that all new tokens grow the latent tail
+        # — the cached step then runs O(1) tokens of compute vs the
+        # recompute path's full window.
         prompt_len = ctx - args.new_tokens
+        if args.phase == "boundary":
+            start_latents = args.num_latents  # already maxed
+        else:
+            start_latents = args.num_latents - args.new_tokens
         prompt = jnp.asarray(
             rng.integers(1, cfg.vocab_size, size=(args.batch, prompt_len), dtype=np.int32)
         )
         gcfg = GenerationConfig(
-            max_new_tokens=args.new_tokens, num_latents=args.num_latents
+            max_new_tokens=args.new_tokens, num_latents=start_latents
         )
 
-        point = {"ctx": ctx, "platform": platform, "batch": args.batch,
+        point = {"ctx": ctx, "phase": args.phase, "platform": platform, "batch": args.batch,
                  "new_tokens": args.new_tokens, "channels": args.num_channels,
                  "layers": args.num_layers, "num_latents": args.num_latents}
         for label, use_cache in (("cached", True), ("recompute", False)):
