@@ -1,21 +1,23 @@
-"""Cached vs recompute decode throughput as context length grows.
+"""Cached vs recompute decode throughput as context length grows, with every
+generated token pinned into ONE cache phase (``--phase``):
 
-The KV cache's claim (``inference/generate.py:27-53``): in the prefix-growth
-phase the cached step elides the full-window embedding + cross-k/v projections
-— the ``2·n·c²`` matmuls — while the recompute path pays them every token.
+- ``latent`` — latent-growth: the cached step runs O(1) tokens of compute
+  per token vs the recompute path's full window (measured ~6× on CPU,
+  ``docs/benchmarks.md`` round-5 curves).
+- ``boundary`` — prefix-growth: the cache elides the full-window embedding +
+  cross-k/v projections (the ``2·n·c²`` matmuls) but recomputes the latent
+  stack like the recompute path does (measured sub-1× on CPU at 256 ch).
+
 Under the static right-aligned window formulation both paths' per-token cost
-is a function of the *window* size ``n = max_seq_len`` (left pads are computed
-and masked), so the claim's scaling axis is context length, not prompt
-length: the cached/recompute ratio must grow with ``n``.
-
-This script measures both paths at a fixed small model (CPU-feasible; pass
-``--tpu`` to run on the default accelerator backend at deployment bf16) over
-a sweep of context lengths, prints one JSON line per point, and a markdown
-table suitable for ``docs/benchmarks.md``.
+is a function of the *window* size ``n = max_seq_len`` (left pads are
+computed and masked), so the scaling axis is context length, not prompt
+length. Prints one JSON line per point and a markdown table suitable for
+``docs/benchmarks.md``.
 
 Usage::
 
-    python examples/perf/decode_scaling.py                  # CPU, 1k->8k
+    python examples/perf/decode_scaling.py                  # boundary, 1k->8k
+    python examples/perf/decode_scaling.py --phase latent   # the cache's win
     python examples/perf/decode_scaling.py --ctxs 1024 2048 # subset
     python examples/perf/decode_scaling.py --tpu            # real chip
 """
@@ -51,6 +53,12 @@ def main() -> None:
     )
     p.add_argument("--out", default=None, help="also append JSON lines here")
     args = p.parse_args()
+    if args.phase == "latent" and args.new_tokens >= args.num_latents:
+        p.error(
+            f"--phase latent pins every generated token into latent growth, "
+            f"which requires --new-tokens ({args.new_tokens}) < "
+            f"--num-latents ({args.num_latents})"
+        )
 
     import jax
 
