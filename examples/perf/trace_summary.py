@@ -64,9 +64,9 @@ def summarize_plane(plane, top: int) -> None:
             lo = line.timestamp_ns * 1000 + ev.offset_ps
             span_lo = lo if span_lo is None else min(span_lo, lo)
             span_hi = max(span_hi, lo + ev.duration_ps)
-        if not totals:
-            continue
         busy_ps = sum(totals.values())
+        if not totals or busy_ps == 0:  # e.g. instant-marker-only lines
+            continue
         span_ms = (span_hi - (span_lo or 0)) / 1e9
         print(f"\n== plane: {plane.name} | line: {line.name or line.id}  "
               f"(span={span_ms:.2f} ms, busy={busy_ps / 1e9:.2f} ms) ==")

@@ -66,6 +66,7 @@ SWEEP = [
         "name": "flash-lhs",
         "impl": "auto",
         "env": {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"},
+        "tpu_only": True,  # the flag is rejected by the CPU backend
     },
     {
         "name": "flash-fusedqkv-lhs",
@@ -74,6 +75,7 @@ SWEEP = [
             "PERCEIVER_FUSED_QKV": "1",
             "XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true",
         },
+        "tpu_only": True,
     },
 ]
 
@@ -168,6 +170,16 @@ def run_one(args_list, env_extra, timeout_s):
     return {"error": "no JSON result on stdout", "wall_s": round(time.monotonic() - t0, 1)}
 
 
+def _on_cpu() -> bool:
+    """True when child subprocesses will land on the CPU backend. The env
+    var is the only cheap signal (the parent never imports jax by design);
+    it is authoritative in both intended environments — the driver's TPU
+    session sets JAX_PLATFORMS=axon, and CPU validation runs set
+    JAX_PLATFORMS=cpu. Membership check, not equality: 'cpu,tpu' etc."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    return "cpu" in [p.strip() for p in platforms.split(",") if p.strip()]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -191,6 +203,8 @@ def main() -> None:
                 f"unknown config {args.trace!r}; choose from "
                 f"{[c['name'] for c in SWEEP]}"
             )
+        if cfg.get("tpu_only") and _on_cpu():
+            raise SystemExit(f"{cfg['name']} is a tpu-only config; needs hardware")
         trace_dir = os.path.abspath(
             os.path.join(os.path.dirname(args.out or "."), f"trace-{cfg['name']}")
         )
@@ -206,6 +220,10 @@ def main() -> None:
     print(f"[tune] ceiling: {results['ceiling']}", file=sys.stderr, flush=True)
 
     for cfg in SWEEP:
+        if cfg.get("tpu_only") and _on_cpu():
+            results["configs"][cfg["name"]] = {"skipped": "tpu-only config"}
+            print(f"[tune] {cfg['name']}: skipped (tpu-only)", file=sys.stderr, flush=True)
+            continue
         print(f"[tune] {cfg['name']}...", file=sys.stderr, flush=True)
         r = run_one(["--child", shape_arg, cfg["impl"]], cfg["env"], args.timeout)
         results["configs"][cfg["name"]] = r
