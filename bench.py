@@ -1,5 +1,7 @@
 """Benchmark: Perceiver AR 8k-context training throughput on one chip, plus
-the Perceiver IO MLM training config and cached-decode throughput.
+the Perceiver IO MLM training config, cached-decode throughput, and a
+mixed-length bucketed-serving probe (``extras.serve``: tokens/s,
+compile_count, p50/p95 queue wait — the serving-layer trajectory).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
 secondary metrics under "extras".
@@ -494,6 +496,20 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "decode": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: bucketed serving probe (mixed-length traffic) ----
+        if left() > 120.0:
+            log("run: serving probe (shape-bucketed micro-batching)")
+            try:
+                srv = _bench_serve(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "serve": srv})
+                log(f"run: serve {srv['tokens_per_sec']} tok/s, "
+                    f"{srv['compile_count']} compiles for "
+                    f"{srv['distinct_prompt_lens']} distinct prompt lengths")
+            except Exception as e:
+                log(f"run: serving probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "serve": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
     log(f"run: wrote {out_path}")
 
 
@@ -622,6 +638,63 @@ def _bench_decode(model, params, cfg):
     )
     out.update(batch=b, prompt_len=prompt_len, new_tokens=new_tokens)
     return out
+
+
+def _bench_serve(model, params, cfg, *, n_requests: int = 24, new_tokens: int = 8):
+    """Mixed-length serving probe: a ragged prompt distribution (>= 8
+    distinct lengths when the context allows) through the shape-bucketed
+    ``ServingEngine`` (docs/serving.md). Two passes over the same traffic:
+    the first pays every bucket compile (``compile_count`` — bounded by the
+    bucket grid, not by the number of distinct shapes), the second measures
+    steady-state serving throughput plus queue-wait percentiles. Shapes are
+    derived from ``cfg`` so the probe also runs at the reduced CPU-fallback
+    shape — the serving trajectory gets a real number without hardware."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.serving import BucketTable, ServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    num_latents = min(16, cfg.max_latents)
+    max_prefix = cfg.max_seq_len - cfg.max_latents
+    max_len = min(256, cfg.max_seq_len // 2, max_prefix + num_latents)
+    lens_grid = sorted({max(num_latents, max_len // 4), max(num_latents, max_len // 2), max_len})
+    table = BucketTable(prompt_lens=tuple(lens_grid), batch_sizes=(2, 4, 8))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+
+    rng = np.random.default_rng(0)
+    lo = max(1, max_len // 8)
+    prompt_lens = rng.integers(lo, max_len + 1, size=n_requests)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=int(n), dtype=np.int32)
+        for n in prompt_lens
+    ]
+
+    compile_engine = ServingEngine(model, params, gcfg, table)
+    compile_engine.serve(prompts)  # pays every bucket compile
+    compile_count = compile_engine.stats()["compiles"]
+
+    engine = ServingEngine(model, params, gcfg, table)
+    t0 = time.perf_counter()
+    outs = engine.serve(prompts)
+    _fetch(outs[-1][-1])
+    dt = time.perf_counter() - t0
+    stats = engine.stats()
+    return {
+        "tokens_per_sec": round(n_requests * new_tokens / dt, 1),
+        "compile_count": compile_count,
+        "steady_state_compiles": stats["compiles"],
+        "p50_queue_wait_ms": stats["queue_wait_ms"]["p50"],
+        "p95_queue_wait_ms": stats["queue_wait_ms"]["p95"],
+        "requests": n_requests,
+        "new_tokens": new_tokens,
+        "batches": stats["batches"],
+        "distinct_prompt_lens": int(len(set(int(n) for n in prompt_lens))),
+        "bucket_grid": stats["bucket_grid"],
+        "prompt_padding_efficiency": stats["prompt_padding_efficiency"],
+    }
 
 
 # --------------------------------------------------------------- parent side

@@ -170,14 +170,45 @@ def run_one(args_list, env_extra, timeout_s):
     return {"error": "no JSON result on stdout", "wall_s": round(time.monotonic() - t0, 1)}
 
 
+_BACKEND_PROBE: dict = {}  # memoized {"is_tpu": bool} from the subprocess probe
+
+
+def _probed_backend_is_tpu(timeout_s: float = 120.0) -> bool:
+    """Probe the backend children will actually get: a tiny subprocess that
+    imports jax and prints ``jax.default_backend()`` (the parent never
+    imports jax by design). Memoized; a probe that fails, hangs, or prints
+    anything but ``tpu`` counts as non-TPU — the conservative answer, since
+    its only consumer skips configs whose XLA flags a CPU backend rejects."""
+    if "is_tpu" not in _BACKEND_PROBE:
+        is_tpu = False
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, timeout=timeout_s,
+            )
+            lines = [l.strip() for l in (proc.stdout or "").splitlines() if l.strip()]
+            is_tpu = proc.returncode == 0 and bool(lines) and lines[-1] == "tpu"
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+        _BACKEND_PROBE["is_tpu"] = is_tpu
+    return _BACKEND_PROBE["is_tpu"]
+
+
 def _on_cpu() -> bool:
-    """True when child subprocesses will land on the CPU backend. The env
-    var is the only cheap signal (the parent never imports jax by design);
-    it is authoritative in both intended environments — the driver's TPU
-    session sets JAX_PLATFORMS=axon, and CPU validation runs set
-    JAX_PLATFORMS=cpu. Membership check, not equality: 'cpu,tpu' etc."""
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    return "cpu" in [p.strip() for p in platforms.split(",") if p.strip()]
+    """True when child subprocesses will NOT land on a TPU backend, so
+    ``tpu_only`` sweep configs (TPU-specific XLA flags) must skip. When
+    ``JAX_PLATFORMS`` is set it is the cheap authoritative signal — the
+    driver's TPU session sets ``axon``, CPU validation runs set ``cpu``
+    (membership check, not equality: 'cpu,tpu' etc.). When it is UNSET the
+    actual backend is probed once in a subprocess (ADVICE r5: an unset env
+    used to read as "not cpu", so tpu_only configs ran on CPU hosts and
+    died on the rejected XLA flag instead of skipping cleanly)."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms is not None and platforms.strip():
+        return "cpu" in [p.strip() for p in platforms.split(",") if p.strip()]
+    return not _probed_backend_is_tpu()
 
 
 def main() -> None:

@@ -100,8 +100,14 @@ def child_env(args) -> dict:
 def run_phase(args, name: str, resume: bool, kill_at: int | None,
               kill_sig: int | None, events: list) -> int:
     """Run one CLI invocation; optionally kill it once metrics.jsonl passes
-    ``kill_at`` steps. Returns the subprocess return code."""
-    log = open(os.path.join(args.root, f"{name}.log"), "w")
+    ``kill_at`` steps. Returns the subprocess return code.
+
+    A per-phase wall-clock watchdog (``--phase-timeout``) bounds every
+    phase: a child that hangs (dead data source, wedged backend claim) is
+    SIGKILLed with the tail of its log as diagnostic instead of blocking
+    the orchestrator forever (ADVICE r5)."""
+    log_path = os.path.join(args.root, f"{name}.log")
+    log = open(log_path, "w")
     t0 = time.time()
     proc = subprocess.Popen(
         cli_cmd(args, resume), cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
@@ -109,8 +115,14 @@ def run_phase(args, name: str, resume: bool, kill_at: int | None,
     )
     metrics = os.path.join(args.root, "run", "metrics.jsonl")
     sent = None
+    watchdog_fired = False
     while proc.poll() is None:
         time.sleep(2.0)
+        if args.phase_timeout and time.time() - t0 > args.phase_timeout:
+            watchdog_fired = True
+            proc.kill()
+            proc.wait()
+            break
         if kill_at is not None and sent is None and os.path.exists(metrics):
             last = latest_step(metrics)
             if last >= kill_at:
@@ -119,6 +131,18 @@ def run_phase(args, name: str, resume: bool, kill_at: int | None,
                 events.append({"event": f"sent signal {kill_sig} ({name})",
                                "at_step": last, "t": round(time.time() - t0, 1)})
     log.close()
+    if watchdog_fired:
+        events.append({"event": f"watchdog killed {name}",
+                       "timeout_s": args.phase_timeout,
+                       "wall_s": round(time.time() - t0, 1)})
+        with open(log_path) as fh:
+            tail = "".join(fh.readlines()[-20:])
+        raise SystemExit(
+            f"[longrun] watchdog: {name} exceeded --phase-timeout="
+            f"{args.phase_timeout:.0f}s and was SIGKILLed; last step seen: "
+            f"{latest_step(metrics) if os.path.exists(metrics) else 'none'}. "
+            f"Tail of {log_path}:\n{tail}"
+        )
     events.append({"event": f"{name} exited", "rc": proc.returncode,
                    "wall_s": round(time.time() - t0, 1)})
     print(f"[longrun] {name}: rc={proc.returncode} "
@@ -260,6 +284,10 @@ def main() -> None:
     p.add_argument("--tpu", action="store_true",
                    help="inherit the accelerator environment instead of "
                    "forcing CPU children")
+    p.add_argument("--phase-timeout", type=float, default=7200.0,
+                   help="per-phase wall-clock watchdog in seconds; a phase "
+                   "that outlives it is SIGKILLed with a diagnostic "
+                   "(0 disables)")
     args = p.parse_args()
 
     # Replay-equality at the SIGKILL seam compares window-averaged losses,

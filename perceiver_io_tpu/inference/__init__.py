@@ -7,7 +7,12 @@ incrementally and the prefix-growth phase via a cross-k/v cache with per-step
 boundary migration (see ``generate.py`` docstring for the phase analysis).
 """
 from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
-from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+from perceiver_io_tpu.inference.generate import (
+    GenerationConfig,
+    executor_cache_stats,
+    generate,
+    reset_executor_caches,
+)
 from perceiver_io_tpu.inference.beam import beam_search
 from perceiver_io_tpu.inference.mask_filler import MaskFiller
 from perceiver_io_tpu.inference.pipelines import (
@@ -27,6 +32,8 @@ __all__ = [
     "sample_logits",
     "generate",
     "GenerationConfig",
+    "executor_cache_stats",
+    "reset_executor_caches",
     "beam_search",
     "MaskFiller",
     "pipeline",

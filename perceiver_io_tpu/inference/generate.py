@@ -475,15 +475,44 @@ def model_fingerprint(model) -> str:
     return fingerprint
 
 
+#: Process-wide hit/miss/evict counters across ALL executor caches (the
+#: generation cache here and the beam cache in ``beam.py``). A miss means a
+#: fresh trace+compile (~1.5 s at test scale) — the serving layer reads these
+#: so retracing under real traffic is observable rather than silent.
+_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def executor_cache_stats() -> dict:
+    """Snapshot of the shared executor-cache counters."""
+    return dict(_CACHE_STATS)
+
+
+def reset_executor_caches() -> None:
+    """Drop every cached executor and zero the counters (test isolation and
+    serving-warmup measurement hook). Rewinding the global counters makes
+    live ``ServingEngine`` instances' construction-time snapshots stale —
+    their ``stats()`` deltas clamp at 0 rather than going negative, but
+    create engines after the reset when exact counts matter."""
+    from perceiver_io_tpu.inference import beam
+
+    _EXECUTOR_CACHE.clear()
+    beam._EXECUTOR_CACHE.clear()
+    for k in _CACHE_STATS:
+        _CACHE_STATS[k] = 0
+
+
 def cached_executor(cache: dict, key, build, *, max_entries: int = 64):
     """FIFO-bounded compile-once cache shared by the generation and beam
     executors: ``build()`` is called (and jitted) only on a key miss."""
     cached = cache.get(key)
     if cached is not None:
+        _CACHE_STATS["hits"] += 1
         return cached
+    _CACHE_STATS["misses"] += 1
     executor = build()
     if len(cache) >= max_entries:
         cache.pop(next(iter(cache)))
+        _CACHE_STATS["evictions"] += 1
     cache[key] = executor
     return executor
 
@@ -501,10 +530,14 @@ def _generation_executor(
     ~2 ms/token of actual compute at test scale); this cache makes repeated
     pipeline calls with the same shape/config dispatch a compiled program.
     Keyed by the module's fingerprint, the frozen :class:`GenerationConfig`,
-    shapes, and the phase plan."""
+    shapes, the phase plan, and the trace-time PERCEIVER_FUSED_QKV flag (a
+    mid-process toggle must rebuild the executor, not silently reuse a trace
+    captured under the other setting)."""
+    from perceiver_io_tpu.models.core.modules import fused_qkv_enabled
+
     key = (
         type(model).__qualname__, model_fingerprint(model), config,
-        b, prompt_len, num_latents, s1, s2, ids_dtype,
+        b, prompt_len, num_latents, s1, s2, ids_dtype, fused_qkv_enabled(),
     )
     return cached_executor(
         _EXECUTOR_CACHE, key,

@@ -64,11 +64,64 @@ def _pad_batch(rows: List[np.ndarray], pad_id: int, side: str) -> Tuple[np.ndarr
 class TextGenerationPipeline(_Pipeline):
     """``pipeline("text-generation")`` parity (reference
     ``clm/huggingface.py:100-143``): prompts → continuation text via the
-    on-device ``lax.scan`` decode loop."""
+    on-device ``lax.scan`` decode loop.
 
-    def __init__(self, model, params, tokenizer):
+    With ``bucketing=True`` calls route through the shape-bucketed serving
+    engine (``perceiver_io_tpu.serving``): prompts are padded to a static
+    bucket grid and micro-batched, so ragged call patterns hit a small
+    pre-compilable executor set instead of one trace per exact batch shape.
+    Greedy output is token-identical either way (generation is left-pad
+    invariant); ``serving_stats()`` exposes the engine counters.
+    """
+
+    def __init__(self, model, params, tokenizer, *, bucketing: bool = False,
+                 bucket_table=None):
         super().__init__(model, params)
         self.tokenizer = tokenizer
+        self.bucketing = bucketing
+        self._bucket_table = bucket_table
+        self._engine = None
+
+    def _make_config(
+        self, *, max_new_tokens: int = 64, min_new_tokens: int = 0,
+        num_latents: int = 1, temperature: float = 1.0,
+        top_k: Optional[int] = None, top_p: Optional[float] = None,
+        repetition_penalty: float = 1.0, num_beams: int = 1,
+        length_penalty: float = 1.0,
+    ) -> GenerationConfig:
+        return GenerationConfig(
+            max_new_tokens=max_new_tokens,
+            min_new_tokens=min_new_tokens,
+            num_latents=num_latents,
+            pad_token_id=self.tokenizer.pad_token_id or 0,
+            eos_token_id=self.tokenizer.eos_token_id,
+            num_beams=num_beams,
+            length_penalty=length_penalty,
+            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p,
+                                    repetition_penalty=repetition_penalty),
+        )
+
+    def _ensure_engine(self, config: GenerationConfig):
+        if self._engine is None:
+            from perceiver_io_tpu.serving import ServingEngine
+
+            self._engine = ServingEngine(
+                self.model, self.params, config, table=self._bucket_table
+            )
+        return self._engine
+
+    def warmup(self, **gen_kwargs) -> int:
+        """Ahead-of-time compile of every serving bucket (``bucketing=True``
+        only); returns the number of fresh executor compiles."""
+        if not self.bucketing:
+            raise ValueError("warmup() requires bucketing=True")
+        config = self._make_config(**gen_kwargs)
+        return self._ensure_engine(config).warmup(config)
+
+    def serving_stats(self) -> Optional[dict]:
+        """Engine counters (compiles, queue waits, cache hits) or ``None``
+        when bucketing is off / nothing was served yet."""
+        return self._engine.stats() if self._engine is not None else None
 
     def __call__(
         self,
@@ -91,30 +144,30 @@ class TextGenerationPipeline(_Pipeline):
         batch = [prompts] if single else list(prompts)
         encoded = [np.asarray(self.tokenizer.encode(p), np.int32) for p in batch]
         pad_id = self.tokenizer.pad_token_id or 0
-        ids, pad = _pad_batch(encoded, pad_id, "left")
-        pad_count = pad.sum(axis=1).astype(np.int32)
 
-        config = GenerationConfig(
-            max_new_tokens=max_new_tokens,
-            min_new_tokens=min_new_tokens,
-            num_latents=num_latents,
-            pad_token_id=pad_id,
-            eos_token_id=self.tokenizer.eos_token_id,
-            num_beams=num_beams,
-            length_penalty=length_penalty,
-            sampling=SamplingConfig(temperature=temperature, top_k=top_k, top_p=top_p,
-                                    repetition_penalty=repetition_penalty),
+        config = self._make_config(
+            max_new_tokens=max_new_tokens, min_new_tokens=min_new_tokens,
+            num_latents=num_latents, temperature=temperature, top_k=top_k,
+            top_p=top_p, repetition_penalty=repetition_penalty,
+            num_beams=num_beams, length_penalty=length_penalty,
         )
-        out = generate(
-            self.model,
-            self.params,
-            jnp.asarray(ids),
-            config,
-            rng=jax.random.PRNGKey(seed),
-            prompt_pad_count=jnp.asarray(pad_count),
-        )
+        if self.bucketing and num_beams == 1:
+            rows = self._ensure_engine(config).serve(
+                encoded, config, rng=jax.random.PRNGKey(seed)
+            )
+        else:
+            ids, pad = _pad_batch(encoded, pad_id, "left")
+            pad_count = pad.sum(axis=1).astype(np.int32)
+            rows = np.asarray(generate(
+                self.model,
+                self.params,
+                jnp.asarray(ids),
+                config,
+                rng=jax.random.PRNGKey(seed),
+                prompt_pad_count=jnp.asarray(pad_count),
+            ))
         texts = []
-        for prompt, row in zip(batch, np.asarray(out)):
+        for prompt, row in zip(batch, rows):
             new = self.tokenizer.decode([t for t in row.tolist() if t != pad_id])
             texts.append(prompt + new if return_full_text else new)
         return texts[0:1] if single else texts

@@ -52,13 +52,16 @@ def _layer_norm(dtype, name: str) -> nn.LayerNorm:
     return nn.LayerNorm(epsilon=LAYER_NORM_EPS, dtype=dtype, name=name, use_fast_variance=False)
 
 
-def _fused_qkv() -> bool:
+def fused_qkv_enabled() -> bool:
     """``PERCEIVER_FUSED_QKV=1`` merges same-input q/k/v (self-attention) and
     k/v (cross-attention) projections into single wider matmuls. Like the
-    ``PERCEIVER_FLASH_*`` knobs this is read at trace time and is NOT part of
-    the jit cache key — set it before the first forward pass (the tuning
-    sweep isolates each setting in a subprocess). Default off until measured
-    on hardware; exactness vs the unfused path is tested either way."""
+    ``PERCEIVER_FLASH_*`` knobs this is read at trace time, so a toggle only
+    affects traces captured afterwards (the tuning sweep isolates each
+    setting in a subprocess). The generation/beam executor caches fold the
+    flag into their cache keys (``generate._generation_executor``), so a
+    mid-process toggle rebuilds those executors instead of silently serving
+    a program traced under the other setting. Default off until measured on
+    hardware; exactness vs the unfused path is tested either way."""
     import os
 
     return os.environ.get("PERCEIVER_FUSED_QKV", "0") == "1"
@@ -159,7 +162,7 @@ class MultiHeadAttention(nn.Module):
         """(b, n, Dkv) -> rotated (b, h, n, ck), (b, h, n, cv). Exposed for
         the KV-cache decode loop (keys are cached post-rotation; rotary is
         relative so a global position offset cancels in attention scores)."""
-        if _fused_qkv() and not self.is_initializing():
+        if fused_qkv_enabled() and not self.is_initializing():
             # One (n, Dkv) x (Dkv, ck+cv) matmul instead of two: k and v
             # always project from the same (often window-length) input, and
             # a single wider matmul keeps the MXU busier per dispatch. The
@@ -225,7 +228,7 @@ class MultiHeadAttention(nn.Module):
         deterministic: bool = True,
     ) -> jnp.ndarray:
         if (
-            _fused_qkv()
+            fused_qkv_enabled()
             and x_q is x_kv  # self-attention: one source feeds q, k and v
             and not self.is_initializing()
         ):

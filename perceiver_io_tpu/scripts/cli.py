@@ -16,7 +16,10 @@ config (``link_arguments`` parity, e.g. vocab_size — reference
 ``scripts/text/mlm.py:12-16``). Subcommands: ``fit``, ``validate``,
 ``test``, ``preproc`` (the reference LightningCLI exposes
 fit/validate/test, ``perceiver/scripts/cli.py:13-48``); ``validate`` and
-``test`` take ``--ckpt <dir>`` to evaluate a saved model.
+``test`` take ``--ckpt <dir>`` to evaluate a saved model; ``serve`` takes
+``--ckpt <dir>`` plus ``--serve.*`` flags and runs bucketed text
+generation through the serving engine (docs/serving.md) — prompts from a
+file or stdin, one JSON completion line each, engine stats at the end.
 
 Model-family entry points are declarative :class:`ModelFamily` records; see
 ``perceiver_io_tpu/scripts/text/clm.py`` for the pattern.
@@ -149,6 +152,27 @@ class LRSchedulerArgs:
     training_steps: Optional[int] = None  # linked to trainer.max_steps
 
 
+@dataclasses.dataclass
+class ServeArgs:
+    """``--serve.*`` flags for the ``serve`` subcommand: bucketed text
+    generation over a ``save_pretrained`` checkpoint (docs/serving.md)."""
+
+    #: prompts file, one per line; omitted = read prompts from stdin
+    prompts: Optional[str] = None
+    max_new_tokens: int = 64
+    num_latents: int = 1
+    temperature: float = 0.0  # greedy by default — deterministic serving
+    #: prompt-length bucket grid; default = powers of two up to the context
+    prompt_buckets: Optional[typing.Tuple[int, ...]] = None
+    #: micro-batch size grid
+    batch_buckets: typing.Tuple[int, ...] = (1, 2, 4, 8)
+    #: compile every bucket before accepting traffic
+    warmup: bool = True
+    seed: int = 0
+    #: append the engine stats JSON line to stdout after the results
+    stats: bool = True
+
+
 # -- the CLI ---------------------------------------------------------------
 @dataclasses.dataclass
 class ModelFamily:
@@ -249,10 +273,17 @@ class CLI:
             self._print_help()
             return None
         subcommand = argv[0]
-        if subcommand not in ("fit", "validate", "test", "preproc"):
+        if subcommand not in ("fit", "validate", "test", "preproc", "serve"):
             raise SystemExit(
-                f"unknown subcommand {subcommand!r} (fit|validate|test|preproc)"
+                f"unknown subcommand {subcommand!r} "
+                "(fit|validate|test|preproc|serve)"
             )
+        if subcommand == "serve":
+            # serve needs no datamodule: the checkpoint's embedded config
+            # picks the model, and prompts come from a file or stdin.
+            known = {"ckpt": str, "params": str}
+            known.update(flag_specs(ServeArgs, "serve"))
+            return self.run_serve(_parse_dotted(argv[1:], known))
 
         # data module choice first (its ctor defines the --data.* space)
         data_name = None
@@ -385,10 +416,92 @@ class CLI:
         trainer.close()
         return state
 
+    # -- serving -----------------------------------------------------------
+    def run_serve(self, values: Dict[str, Any]) -> list:
+        """``serve --ckpt <dir>``: bucketed text generation over a saved
+        model — prompts (file or stdin) → one JSON line per completion,
+        plus a final engine-stats line (docs/serving.md)."""
+        import json
+        import time
+
+        from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+        from perceiver_io_tpu.inference.pipelines import TextGenerationPipeline
+        from perceiver_io_tpu.models import model_for_config
+        from perceiver_io_tpu.serving import BucketTable
+        from perceiver_io_tpu.training.checkpoint import load_pretrained
+
+        ckpt = values.get("ckpt") or values.get("params")
+        if not ckpt:
+            raise SystemExit("serve requires --ckpt <save_pretrained dir>")
+        args = build_dataclass(ServeArgs, values, "serve")
+        params, model_cfg = load_pretrained(ckpt)
+        if model_cfg is None:
+            raise SystemExit(f"{ckpt} has no embedded model config")
+        model = model_for_config(model_cfg)
+        from perceiver_io_tpu.models.text.clm import CausalLanguageModel
+
+        if not isinstance(model, CausalLanguageModel):
+            # The decode side is the byte tokenizer; a non-text AR family
+            # (e.g. symbolic audio) would sample ids the tokenizer cannot
+            # decode — fail fast instead of mid-stream.
+            raise SystemExit(
+                "serve currently supports text CLM checkpoints (byte "
+                f"tokenizer); got {type(model).__name__}"
+            )
+
+        table = BucketTable.for_model(model)
+        if args.prompt_buckets or tuple(args.batch_buckets) != (1, 2, 4, 8):
+            table = BucketTable(
+                prompt_lens=tuple(args.prompt_buckets or table.prompt_lens),
+                batch_sizes=tuple(args.batch_buckets),
+            )
+        pipe = TextGenerationPipeline(
+            model, params, ByteTokenizer(padding_side="left"),
+            bucketing=True, bucket_table=table,
+        )
+        gen_kwargs = dict(
+            max_new_tokens=args.max_new_tokens,
+            num_latents=args.num_latents,
+            temperature=args.temperature,
+        )
+        if args.warmup:
+            t0 = time.monotonic()
+            compiles = pipe.warmup(**gen_kwargs)
+            print(
+                f"[serve] warmup compiled {compiles} executors in "
+                f"{time.monotonic() - t0:.1f}s", file=sys.stderr, flush=True,
+            )
+
+        if args.prompts:
+            with open(args.prompts) as fh:
+                prompts = [line.rstrip("\n") for line in fh if line.strip()]
+        else:
+            prompts = [line.rstrip("\n") for line in sys.stdin if line.strip()]
+        if not prompts:
+            raise SystemExit("serve: no prompts (empty file/stdin)")
+
+        t0 = time.monotonic()
+        texts = pipe(
+            prompts, seed=args.seed, return_full_text=False, **gen_kwargs
+        )
+        wall_s = time.monotonic() - t0
+        results = [
+            {"prompt": p, "completion": t} for p, t in zip(prompts, texts)
+        ]
+        for row in results:
+            print(json.dumps(row), flush=True)
+        if args.stats:
+            stats = pipe.serving_stats() or {}
+            stats["wall_s"] = round(wall_s, 3)
+            print(json.dumps({"serve_stats": stats}), flush=True)
+        return results
+
     def _print_help(self) -> None:
-        print(f"usage: {self.family.name} {{fit|validate|test|preproc}} [--flag=value ...]")
+        print(f"usage: {self.family.name} {{fit|validate|test|preproc|serve}} [--flag=value ...]")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --config=<yaml> --data=<name> --ckpt=<dir>")
+        print("serve: --ckpt=<dir> --serve.prompts=<file|stdin> --serve.max_new_tokens "
+              "--serve.prompt_buckets --serve.batch_buckets --serve.warmup")
         print(f"data modules: {sorted(self.family.data_registry)}")
 
 

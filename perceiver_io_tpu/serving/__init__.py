@@ -1,0 +1,9 @@
+"""Serving layer — shape bucketing + continuous micro-batching over the
+compiled generation executors (docs/serving.md). The first load-path layer
+between "a jitted ``generate()``" and "a service": ragged traffic lands on
+a small pre-compilable executor grid instead of retracing per exact shape.
+"""
+from perceiver_io_tpu.serving.buckets import BucketTable
+from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine
+
+__all__ = ["BucketTable", "ServeRequest", "ServingEngine"]

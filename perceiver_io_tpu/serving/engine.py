@@ -1,0 +1,270 @@
+"""Shape-bucketed serving engine: continuous micro-batching over the
+compiled generation executors.
+
+``generate()`` compiles one executor per exact ``(batch, prompt_len,
+num_latents, s1, s2)`` plan, and ``TextGenerationPipeline`` pads each
+caller's batch to its own max width — so ragged real traffic causes
+unbounded retracing and tiny fixed batches. This engine is the first
+load-path layer between "a jitted ``generate()``" and "a service":
+
+- **Bucketing** — every prompt is padded up to a static
+  ``(batch_size, prompt_len)`` grid (:class:`~.buckets.BucketTable`), so
+  all traffic lands on at most ``len(table)`` pre-compilable executors
+  (plus the phase-plan split, see :meth:`ServingEngine.warmup`).
+- **Continuous micro-batching** — queued requests are packed FIFO into the
+  next bucket slot via the existing left-pad path (``prompt_pad_count``);
+  unfilled rows are dummy pad rows whose outputs are discarded; results are
+  split back per request.
+- **Warmup** — :meth:`ServingEngine.warmup` compiles every bucket before
+  traffic is accepted.
+- **Observability** — the executor cache's hit/miss/evict counters
+  (``generate.executor_cache_stats``) plus queue-wait percentiles surface
+  in :meth:`ServingEngine.stats`, so residual retracing is measured, never
+  silent.
+
+Exactness: generation is left-pad invariant (padded keys are masked out of
+every softmax; ``tests/test_generate.py`` pins padded == unpadded against
+the torch reference), so for greedy decoding the bucketed output is
+token-identical to the unbucketed path. The effective latent count is
+clamped by the bucket width (``min(bucket_len, config.num_latents)``)
+exactly as the unbucketed pipeline clamps it by the batch's max width —
+keep ``config.num_latents`` at or below the shortest served prompt if
+per-request calls must match bit-for-bit.
+
+The engine is deliberately synchronous and single-owner: ``submit()``
+enqueues, ``step()`` drains one micro-batch, ``serve()`` is submit-all +
+drain. An async front end (HTTP/RPC) drives the same queue from its own
+loop; device work already serializes inside each compiled executor.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.inference.generate import (
+    GenerationConfig,
+    executor_cache_stats,
+    generate,
+)
+from perceiver_io_tpu.serving.buckets import BucketTable
+
+
+@dataclass
+class ServeRequest:
+    """One queued prompt and, after its micro-batch ran, its result row."""
+
+    request_id: int
+    prompt: np.ndarray  # (len,) int32, unpadded
+    config: GenerationConfig
+    submitted_at: float
+    started_at: Optional[float] = None
+    result: Optional[np.ndarray] = None  # (max_new_tokens,) ids, pad after EOS
+
+    @property
+    def done(self) -> bool:
+        return self.result is not None
+
+
+class ServingEngine:
+    """Request queue + scheduler over the bucketed generation executors.
+
+    :param model: an ``AutoregressiveSequenceModel`` (CLM / symbolic audio).
+    :param params: its parameter tree.
+    :param config: default :class:`GenerationConfig` (per-request override
+        via ``submit(..., config=...)``; only identical-config requests are
+        packed into one micro-batch).
+    :param table: the bucket grid; defaults to a powers-of-two grid up to
+        the model's context length (:meth:`BucketTable.for_model`).
+    :param rng: base PRNG key; each micro-batch uses a fresh split.
+    """
+
+    def __init__(self, model, params, config: Optional[GenerationConfig] = None,
+                 table: Optional[BucketTable] = None, *, rng: Optional[jax.Array] = None):
+        self.model = model
+        self.params = params
+        self.config = config or GenerationConfig()
+        self.table = table or BucketTable.for_model(model)
+        too_long = [L for L in self.table.prompt_lens if L > model.max_seq_len]
+        if too_long:
+            raise ValueError(
+                f"prompt buckets {too_long} exceed the model context "
+                f"length {model.max_seq_len}"
+            )
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._queue: List[ServeRequest] = []
+        self._next_id = 0
+        self._cache0 = executor_cache_stats()
+        self._waits_ms: List[float] = []
+        self._batches = 0
+        self._requests = 0
+        self._tokens_generated = 0
+        self._real_prompt_tokens = 0
+        self._padded_prompt_tokens = 0
+
+    # -- queue front --------------------------------------------------------
+    def submit(self, prompt, config: Optional[GenerationConfig] = None) -> ServeRequest:
+        """Enqueue one prompt (1-D token ids); returns its request handle."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("cannot serve an empty prompt")
+        cfg = config or self.config
+        self._pick_prompt_bucket(int(prompt.size), cfg)  # fail fast, not mid-batch
+        req = ServeRequest(self._next_id, prompt, cfg, time.monotonic())
+        self._next_id += 1
+        self._queue.append(req)
+        self._requests += 1
+        return req
+
+    def serve(self, prompts: Sequence, config: Optional[GenerationConfig] = None,
+              *, rng: Optional[jax.Array] = None) -> List[np.ndarray]:
+        """Submit every prompt, drain the queue, return results in order."""
+        if rng is not None:
+            self._rng = rng
+        reqs = [self.submit(p, config) for p in prompts]
+        self.run_until_idle()
+        return [r.result for r in reqs]
+
+    def run_until_idle(self) -> int:
+        """Drain the whole queue; returns the number of requests served."""
+        served = 0
+        while True:
+            n = self.step()
+            if n == 0:
+                return served
+            served += n
+
+    # -- scheduler ----------------------------------------------------------
+    def _pick_prompt_bucket(self, length: int, cfg: GenerationConfig) -> int:
+        """Smallest prompt bucket that fits ``length`` AND the model's
+        prefix capacity under ``cfg`` (``generate`` rejects plans whose
+        nominal prefix ``L - min(L, num_latents)`` exceeds
+        ``max_prefix_len``)."""
+        max_prefix = self.model.max_prefix_len
+        for cap in self.table.prompt_lens:
+            if cap < length:
+                continue
+            if cap - min(cap, cfg.num_latents) > max_prefix:
+                continue
+            return cap
+        raise ValueError(
+            f"no feasible prompt bucket for length {length} with "
+            f"num_latents={cfg.num_latents}: buckets {self.table.prompt_lens} "
+            f"must satisfy len <= {self.model.max_seq_len} and "
+            f"len - num_latents <= max_prefix_len={max_prefix}"
+        )
+
+    def step(self) -> int:
+        """Run ONE micro-batch: the queue head plus following requests with
+        the same config, packed FIFO into the next bucket slot. Returns the
+        number of real requests served (0 = queue empty)."""
+        if not self._queue:
+            return 0
+        cfg = self._queue[0].config
+        picked: List[ServeRequest] = []
+        rest: List[ServeRequest] = []
+        for req in self._queue:
+            if len(picked) < self.table.batch_sizes[-1] and req.config == cfg:
+                picked.append(req)
+            else:
+                rest.append(req)
+        self._queue = rest
+
+        b = self.table.batch_bucket(len(picked))
+        length = self._pick_prompt_bucket(max(r.prompt.size for r in picked), cfg)
+        ids = np.full((b, length), cfg.pad_token_id, np.int32)
+        # Dummy filler rows claim zero pads — a full-width "prompt" of pad-id
+        # tokens whose output is computed and dropped. Zero, not length-1:
+        # ``generate`` enables the cached prefix-growth phase only when EVERY
+        # row's pad count fits the nominal prefix (``phase2_ok``), so a
+        # max-padded filler would silently demote an underfilled micro-batch
+        # to the slow windowed-recompute plan. Attention is per-row; filler
+        # content never touches real rows.
+        pad_count = np.zeros((b,), np.int32)
+        now = time.monotonic()
+        for i, req in enumerate(picked):
+            ids[i, length - req.prompt.size:] = req.prompt
+            pad_count[i] = length - req.prompt.size
+            req.started_at = now
+            self._waits_ms.append((now - req.submitted_at) * 1e3)
+
+        self._rng, key = jax.random.split(self._rng)
+        out = np.asarray(
+            generate(
+                self.model, self.params, jnp.asarray(ids), cfg,
+                rng=key, prompt_pad_count=jnp.asarray(pad_count),
+            )
+        )
+        for i, req in enumerate(picked):
+            req.result = out[i]
+        self._batches += 1
+        self._tokens_generated += len(picked) * cfg.max_new_tokens
+        self._real_prompt_tokens += sum(int(r.prompt.size) for r in picked)
+        self._padded_prompt_tokens += b * length
+        return len(picked)
+
+    # -- ahead-of-time warmup ----------------------------------------------
+    def warmup(self, config: Optional[GenerationConfig] = None) -> int:
+        """Compile every feasible bucket before accepting traffic; returns
+        the number of fresh executor compiles.
+
+        Each ``(batch, prompt_len)`` cell is driven through ``generate``
+        with BOTH phase plans it can map to at serve time: zero left pads
+        (prefix-growth cache eligible) and maximal left pads (pad overflow
+        beyond the nominal prefix disables phase 2, a different static
+        plan). Cells infeasible under ``config`` (prefix capacity) are
+        skipped — serve-time scheduling skips them identically."""
+        cfg = config or self.config
+        before = executor_cache_stats()["misses"]
+        max_prefix = self.model.max_prefix_len
+        for b, length in self.table.grid():
+            nominal_prefix = length - min(length, cfg.num_latents)
+            if nominal_prefix > max_prefix:
+                continue
+            pad_variants = {0}
+            if length - 1 > nominal_prefix:
+                pad_variants.add(length - 1)
+            for pad in pad_variants:
+                ids = jnp.full((b, length), cfg.pad_token_id, jnp.int32)
+                pad_count = jnp.full((b,), pad, jnp.int32)
+                generate(self.model, self.params, ids, cfg,
+                         rng=jax.random.PRNGKey(0), prompt_pad_count=pad_count)
+        return executor_cache_stats()["misses"] - before
+
+    # -- observability ------------------------------------------------------
+    def stats(self) -> dict:
+        """Serving counters since engine construction. ``compiles`` is the
+        executor-cache miss delta — the engine assumes it owns the process's
+        generation traffic over its lifetime (true for the CLI, bench probe,
+        and tests)."""
+        cache_now = executor_cache_stats()
+        # clamp at 0: reset_executor_caches() mid-lifetime rewinds the global
+        # counters below this engine's construction-time snapshot
+        cache = {k: max(0, cache_now[k] - self._cache0[k]) for k in cache_now}
+        waits = sorted(self._waits_ms)
+
+        def pct(p: float) -> Optional[float]:
+            if not waits:
+                return None
+            return round(waits[min(len(waits) - 1, int(round(p / 100.0 * (len(waits) - 1))))], 3)
+
+        return {
+            "requests": self._requests,
+            "batches": self._batches,
+            "queued": len(self._queue),
+            "compiles": cache["misses"],
+            "executor_cache": cache,
+            "queue_wait_ms": {"p50": pct(50.0), "p95": pct(95.0)},
+            "tokens_generated": self._tokens_generated,
+            "prompt_padding_efficiency": round(
+                self._real_prompt_tokens / max(1, self._padded_prompt_tokens), 4
+            ),
+            "bucket_grid": {
+                "prompt_lens": list(self.table.prompt_lens),
+                "batch_sizes": list(self.table.batch_sizes),
+            },
+        }

@@ -87,9 +87,48 @@ def test_fused_changes_nothing_when_disabled(fused_env):
     """With the flag unset the code path is byte-identical to before: the
     separate projections run (guarded by the same helper the fused path
     uses), so a stale env var cannot silently flip numerics."""
-    from perceiver_io_tpu.models.core.modules import _fused_qkv
+    from perceiver_io_tpu.models.core.modules import fused_qkv_enabled
 
     os.environ.pop("PERCEIVER_FUSED_QKV", None)
-    assert _fused_qkv() is False
+    assert fused_qkv_enabled() is False
     _toggle("1")
-    assert _fused_qkv() is True
+    assert fused_qkv_enabled() is True
+
+
+def test_executor_cache_keys_on_fused_flag(fused_env):
+    """The trace-time-read footgun, resolved (ADVICE r5): a mid-process
+    PERCEIVER_FUSED_QKV toggle must rebuild the generation executor (the
+    flag is part of the cache key), then toggling back must HIT the first
+    executor — never silently reuse a program traced under the other
+    setting."""
+    from perceiver_io_tpu.inference.generate import (
+        GenerationConfig,
+        executor_cache_stats,
+        generate,
+    )
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=41, max_seq_len=16, max_latents=8, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(cfg)
+    ids = jnp.asarray(np.random.default_rng(2).integers(1, 41, (1, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32), 8)["params"]
+    gcfg = GenerationConfig(
+        max_new_tokens=3, num_latents=2, sampling=SamplingConfig(temperature=0.0)
+    )
+
+    _toggle("0")
+    out0 = np.asarray(generate(model, params, ids, gcfg))
+    before = executor_cache_stats()
+    _toggle("1")
+    out1 = np.asarray(generate(model, params, ids, gcfg))
+    mid = executor_cache_stats()
+    assert mid["misses"] - before["misses"] == 1  # fresh executor, not reuse
+    _toggle("0")
+    out2 = np.asarray(generate(model, params, ids, gcfg))
+    after = executor_cache_stats()
+    assert after["misses"] == mid["misses"] and after["hits"] - mid["hits"] == 1
+    np.testing.assert_array_equal(out0, out2)
+    np.testing.assert_array_equal(out0, out1)  # fused path is exact anyway

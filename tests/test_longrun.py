@@ -41,3 +41,27 @@ def test_longrun_orchestrator_smoke(tmp_path):
     assert (tmp_path / "curve.csv").exists()
     curve = (tmp_path / "curve.csv").read_text().strip().splitlines()
     assert curve[0] == "step,train_loss" and len(curve) > 5
+
+
+@pytest.mark.slow
+def test_longrun_watchdog_kills_hung_phase(tmp_path):
+    """A phase that outlives --phase-timeout is SIGKILLed and the
+    orchestrator exits with a diagnostic (log tail + last step) instead of
+    blocking forever (ADVICE r5). The tiny timeout fires long before the
+    child finishes importing, which is exactly the hung-child shape."""
+    proc = subprocess.run(
+        [
+            sys.executable, "examples/training/longrun.py",
+            "--root", str(tmp_path),
+            "--max-steps", "40", "--kill1", "10", "--kill2", "20",
+            "--batch", "2", "--seq", "64", "--latents", "32",
+            "--channels", "32", "--layers", "1",
+            "--train-docs", "8", "--doc-chars", "1024",
+            "--val-every", "20", "--log-every", "5", "--snap-every", "10",
+            "--phase-timeout", "3",
+        ],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode != 0
+    blob = proc.stdout + proc.stderr
+    assert "watchdog" in blob and "phase1" in blob and "--phase-timeout" in blob

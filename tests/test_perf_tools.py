@@ -58,3 +58,70 @@ def test_trace_summary_on_live_capture(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "== plane:" in proc.stdout
     assert "%busy" in proc.stdout
+
+
+# -- tune_step backend detection (ADVICE r5) --------------------------------
+@pytest.fixture
+def tune_step():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tune_step", str(REPO_ROOT / "examples" / "perf" / "tune_step.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_on_cpu_env_var_is_authoritative(tune_step, monkeypatch):
+    """When JAX_PLATFORMS is set it decides directly — no subprocess probe
+    (the probe would burn a jax import per check)."""
+    def boom(*a, **k):
+        raise AssertionError("probe must not run when JAX_PLATFORMS is set")
+
+    monkeypatch.setattr(tune_step, "_probed_backend_is_tpu", boom)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert tune_step._on_cpu() is True
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert tune_step._on_cpu() is False
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,tpu")  # membership, not equality
+    assert tune_step._on_cpu() is True
+
+
+def test_on_cpu_probes_backend_when_env_unset(tune_step, monkeypatch):
+    """Unset JAX_PLATFORMS used to read as 'not cpu', so tpu_only sweep
+    configs ran on CPU hosts and died on the rejected XLA flag. Now the
+    actual backend is probed (memoized) and anything but 'tpu' — including
+    a hung or failing probe — skips cleanly."""
+    import subprocess as sp
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+
+    class FakeProc:
+        def __init__(self, out):
+            self.returncode = 0
+            self.stdout = out
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return FakeProc("cpu\n")
+
+    monkeypatch.setattr(tune_step.subprocess, "run", fake_run)
+    tune_step._BACKEND_PROBE.clear()
+    assert tune_step._on_cpu() is True
+    assert tune_step._on_cpu() is True
+    assert len(calls) == 1  # memoized: one probe per process
+
+    tune_step._BACKEND_PROBE.clear()
+    monkeypatch.setattr(
+        tune_step.subprocess, "run", lambda cmd, **kw: FakeProc("some warning\ntpu\n")
+    )
+    assert tune_step._on_cpu() is False  # real TPU: tpu_only configs run
+
+    def hang(cmd, **kw):
+        raise sp.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    tune_step._BACKEND_PROBE.clear()
+    monkeypatch.setattr(tune_step.subprocess, "run", hang)
+    assert tune_step._on_cpu() is True  # hung claim counts as non-TPU
