@@ -1,13 +1,18 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast cov bench dryrun lint
+.PHONY: test test-fast chaos cov bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
 
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+# deterministic fault-injection suite (docs/reliability.md) — CPU-fast,
+# also included in the tier-1 "not slow" run
+chaos:
+	$(PY) -m pytest tests/ -q -m chaos --continue-on-collection-errors
 
 cov:
 	$(PY) -m pytest tests/ -q --cov=perceiver_io_tpu --cov-report=term-missing

@@ -510,6 +510,20 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "serve": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: chaos drill (fault-injected serving, deterministic) ----
+        if left() > 60.0:
+            log("run: chaos probe (backpressure / deadlines / fault isolation)")
+            try:
+                chs = _bench_chaos(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "chaos": chs})
+                log(f"run: chaos survived={chs['survived']} "
+                    f"(shed {chs['shed']}, timed_out {chs['timed_out']}, "
+                    f"failed {chs['failed']}, completed {chs['completed']})")
+            except Exception as e:
+                log(f"run: chaos probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "chaos": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
     log(f"run: wrote {out_path}")
 
 
@@ -694,6 +708,67 @@ def _bench_serve(model, params, cfg, *, n_requests: int = 24, new_tokens: int = 
         "distinct_prompt_lens": int(len(set(int(n) for n in prompt_lens))),
         "bucket_grid": stats["bucket_grid"],
         "prompt_padding_efficiency": stats["prompt_padding_efficiency"],
+    }
+
+
+def _bench_chaos(model, params, cfg, *, n_requests: int = 8, new_tokens: int = 4):
+    """Deterministic chaos drill over the serving engine (docs/reliability.md):
+    a bounded queue under overload (shed counter), one request hung past its
+    deadline (``timed_out``), one request failed at pack time (``failed``) —
+    while every other request completes. Faults come from the explicit-hook
+    chaos registry on a fake clock, so the probe's outcome is bit-identical
+    on every run and every backend; ``survived`` asserts the engine's
+    accounting closed (submitted == completed + timed_out + failed + shed)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.reliability import QueueFull
+    from perceiver_io_tpu.reliability.chaos import ChaosRegistry, FakeClock
+    from perceiver_io_tpu.serving import BucketTable, ServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    num_latents = min(8, cfg.max_latents)
+    max_len = min(32, cfg.max_seq_len // 2, cfg.max_seq_len - cfg.max_latents + num_latents)
+    table = BucketTable(prompt_lens=(max_len,), batch_sizes=(2,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+
+    chaos = ChaosRegistry()
+    chaos.hang_request(1, delay_s=2.0)  # > its 1s deadline, < the others'
+    chaos.fail_request(2)
+    engine = ServingEngine(
+        model, params, gcfg, table,
+        max_queue=n_requests - 2, default_deadline_s=60.0,
+        clock=FakeClock(), chaos=chaos,
+    )
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=max_len, dtype=np.int32)
+        for _ in range(n_requests)
+    ]
+    shed = 0
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        try:
+            engine.submit(p, deadline_s=1.0 if i == 1 else None)
+        except QueueFull:
+            shed += 1
+    engine.drain()
+    wall_s = time.perf_counter() - t0
+    s = engine.stats()
+    accounted = s["completed"] + s["timed_out"] + s["failed"] + shed
+    return {
+        "submitted": n_requests,
+        "shed": shed,
+        "timed_out": s["timed_out"],
+        "failed": s["failed"],
+        "completed": s["completed"],
+        "batches": s["batches"],
+        "survived": accounted == n_requests and s["queued"] == 0,
+        "ready_after_drain": engine.health()["ready"],
+        "wall_s": round(wall_s, 3),
     }
 
 

@@ -17,9 +17,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import numpy as np
+
+from perceiver_io_tpu.reliability.retry import RetryPolicy, call_with_retry
 
 
 def host_shard_info() -> tuple[int, int]:
@@ -58,6 +61,12 @@ class DataLoader:
     :param collate_fn: ``examples -> batch dict``; default stacks arrays.
     :param prefetch: number of batches buffered on a background thread
         (0 disables threading).
+    :param retry_policy: retry transient per-example fetch failures with
+        exponential backoff (:class:`~perceiver_io_tpu.reliability.RetryPolicy`)
+        instead of killing the run — for datasets backed by remote/flaky
+        storage. None (default) fails fast like before.
+    :param retry_sleep: backoff sleep hook (injectable for deterministic
+        chaos tests).
     """
 
     def __init__(
@@ -71,6 +80,8 @@ class DataLoader:
         drop_last: bool = True,
         collate_fn: Optional[Callable] = None,
         prefetch: int = 2,
+        retry_policy: Optional[RetryPolicy] = None,
+        retry_sleep: Callable[[float], None] = time.sleep,
     ):
         if shard_index is None or shard_count is None:
             auto_index, auto_count = host_shard_info()
@@ -87,8 +98,17 @@ class DataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn or default_collate
         self.prefetch = prefetch
+        self.retry_policy = retry_policy
+        self.retry_sleep = retry_sleep
         self._epoch = 0
         self._start_batch = 0
+
+    def _fetch(self, i: int):
+        if self.retry_policy is None:
+            return self.dataset[i]
+        return call_with_retry(
+            lambda: self.dataset[i], self.retry_policy, sleep=self.retry_sleep
+        )
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
@@ -130,7 +150,7 @@ class DataLoader:
             chunk = indices[start : start + self.batch_size]
             if not len(chunk):
                 return
-            yield self.collate_fn([self.dataset[int(i)] for i in chunk])
+            yield self.collate_fn([self._fetch(int(i)) for i in chunk])
         self._epoch += 1  # auto-advance so re-iteration reshuffles
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:

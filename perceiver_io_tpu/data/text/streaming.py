@@ -17,6 +17,7 @@ Differences from the reference, all TPU-motivated:
 from __future__ import annotations
 
 import random
+import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
@@ -24,6 +25,7 @@ import numpy as np
 from perceiver_io_tpu.data.loader import host_shard_info
 from perceiver_io_tpu.data.text.collators import IGNORE_INDEX
 from perceiver_io_tpu.data.text.tokenizers import load_tokenizer
+from perceiver_io_tpu.reliability.retry import RetryPolicy, resilient_source
 
 
 def shard_iterable(source: Iterable, shard_index: int, shard_count: int) -> Iterator:
@@ -61,6 +63,12 @@ class StreamingTextPipeline:
     :param min_seq_len: if set, each chunk keeps a random
         ``[min_seq_len, max_seq_len]`` prefix and pads the rest.
     :param shard_index/shard_count: this host's shard; default from jax.
+    :param retry_policy: survive transient source failures (HTTP hiccups on
+        hub streams) by re-opening the source with exponential backoff and
+        fast-forwarding past the records already consumed
+        (:func:`~perceiver_io_tpu.reliability.resilient_source`). None
+        (default) fails fast like before.
+    :param retry_sleep: backoff sleep hook (injectable for chaos tests).
     """
 
     def __init__(
@@ -74,6 +82,8 @@ class StreamingTextPipeline:
         seed: int = 0,
         shard_index: Optional[int] = None,
         shard_count: Optional[int] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        retry_sleep: Callable[[float], None] = time.sleep,
     ):
         if isinstance(tokenizer, str):
             tokenizer = load_tokenizer(tokenizer)
@@ -90,10 +100,19 @@ class StreamingTextPipeline:
         self.seed = seed
         self.shard_index = shard_index
         self.shard_count = shard_count
+        self.retry_policy = retry_policy
+        self.retry_sleep = retry_sleep
 
     def _chunks(self) -> Iterator[np.ndarray]:
         chunk_size = self.max_seq_len + 1
-        source: Iterable = self.source_fn()
+        if self.retry_policy is not None:
+            # retry wraps the RAW source so a re-opened stream fast-forwards
+            # in source order, before sharding/shuffling see any records
+            source: Iterable = resilient_source(
+                self.source_fn, self.retry_policy, sleep=self.retry_sleep
+            )
+        else:
+            source = self.source_fn()
         source = shard_iterable(source, self.shard_index, self.shard_count)
         if self.shuffle_window_size:
             source = window_shuffle(source, self.shuffle_window_size, self.seed)
@@ -153,6 +172,7 @@ class C4DataModule:
         shard_count: Optional[int] = None,
         dataset_path: str = "allenai/c4",
         dataset_name: str = "en",
+        source_max_retries: int = 3,
     ):
         self.tokenizer = load_tokenizer(tokenizer)
         self.max_seq_len = max_seq_len
@@ -164,6 +184,13 @@ class C4DataModule:
         self.shard_count = shard_count
         self.dataset_path = dataset_path
         self.dataset_name = dataset_name
+        # hub streams fail transiently as a matter of course; retry them by
+        # default (0 disables — fail fast)
+        self.retry_policy = (
+            RetryPolicy(max_retries=source_max_retries)
+            if source_max_retries > 0
+            else None
+        )
 
     @property
     def vocab_size(self) -> int:
@@ -190,6 +217,7 @@ class C4DataModule:
             seed=self.shuffle_window_seed,
             shard_index=self.shard_index,
             shard_count=self.shard_count,
+            retry_policy=self.retry_policy,
         )
 
     def train_dataloader(self) -> StreamingTextPipeline:

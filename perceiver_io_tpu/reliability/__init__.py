@@ -1,0 +1,56 @@
+"""Fault-tolerance layer (docs/reliability.md).
+
+Production TPU stacks treat preemption, data faults, and loss spikes as
+routine events, not crashes (PAPERS.md: the pjit/TPUv4 scalable-training
+paper's divergence-recovery loop; the Gemma-on-TPU serving comparison's
+backpressure/deadline practices). This package holds the pieces both load
+paths share:
+
+- :class:`QueueFull` — the serving engine's explicit backpressure signal
+  (``ServingEngine.submit`` raises it past ``max_queue`` instead of letting
+  the queue grow unboundedly).
+- :mod:`~perceiver_io_tpu.reliability.retry` — exponential-backoff retry for
+  transient data-source faults (``RetryPolicy``, ``call_with_retry``,
+  ``resilient_source``), wired into ``data.loader.DataLoader`` and
+  ``data.text.streaming.StreamingTextPipeline``.
+- :mod:`~perceiver_io_tpu.reliability.chaos` — a deterministic, seed-free
+  fault-injection registry (``ChaosRegistry``) plus a controllable
+  ``FakeClock``. Faults fire at explicit hook sites in the trainer, loader,
+  and serving engine — never via monkeypatched timing — so every chaos test
+  reproduces bit-identically on CPU.
+
+The trainer's divergence policies (``TrainerConfig.non_finite_policy`` =
+``halt`` / ``skip`` / ``rollback``) build on these hooks; see
+``training/trainer.py`` and docs/reliability.md.
+"""
+from __future__ import annotations
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the serving queue is at ``max_queue``; the request was
+    shed, not enqueued. Callers either retry after draining (the CLI steps
+    the engine and resubmits) or propagate load-shedding upstream."""
+
+
+from perceiver_io_tpu.reliability.chaos import (  # noqa: E402
+    ChaosRegistry,
+    FakeClock,
+    Fault,
+    InjectedFault,
+)
+from perceiver_io_tpu.reliability.retry import (  # noqa: E402
+    RetryPolicy,
+    call_with_retry,
+    resilient_source,
+)
+
+__all__ = [
+    "QueueFull",
+    "ChaosRegistry",
+    "FakeClock",
+    "Fault",
+    "InjectedFault",
+    "RetryPolicy",
+    "call_with_retry",
+    "resilient_source",
+]

@@ -1,0 +1,104 @@
+"""Exponential-backoff retry for transient data faults.
+
+Streaming corpora (HF hub streams, GCS reads) fail transiently all the
+time; a multi-hour TPU run must not die because one HTTP read did. Two
+shapes of retry live here:
+
+- :func:`call_with_retry` — retry a single call (the map-style loader's
+  per-example fetch).
+- :func:`resilient_source` — retry a *stream*: on a mid-iteration
+  exception, re-open the source and fast-forward past the records already
+  emitted, so downstream consumers see one uninterrupted, duplicate-free
+  stream. Assumes the source replays deterministically (true for file and
+  hub streams); the fast-forward re-reads, so seek cost is O(position) per
+  retry.
+
+``sleep`` is injectable everywhere so chaos tests assert the exact backoff
+schedule without waiting for it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable, Iterator, Optional, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: attempt ``k`` (0-based) sleeps
+    ``min(backoff_base_s * backoff_factor**k, backoff_max_s)`` before
+    retrying; after ``max_retries`` failed attempts the error propagates."""
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.5
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+
+    def delay_s(self, attempt: int) -> float:
+        return min(
+            self.backoff_base_s * self.backoff_factor ** attempt,
+            self.backoff_max_s,
+        )
+
+
+def call_with_retry(
+    fn: Callable,
+    policy: RetryPolicy,
+    *,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """Call ``fn()`` with up to ``policy.max_retries`` backed-off retries."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if attempt >= policy.max_retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(policy.delay_s(attempt))
+            attempt += 1
+
+
+def resilient_source(
+    source_fn: Callable[[], Iterable],
+    policy: RetryPolicy,
+    *,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> Iterator:
+    """Iterate ``source_fn()``, surviving mid-stream exceptions.
+
+    On a failure, back off per ``policy``, re-invoke ``source_fn`` and skip
+    the records already emitted (deterministic replay assumed), then resume
+    yielding. The retry budget resets whenever a record is successfully
+    emitted, so ``max_retries`` bounds *consecutive* failures, not total
+    failures over an arbitrarily long stream.
+    """
+    emitted = 0
+    attempt = 0
+    while True:
+        try:
+            it = iter(source_fn())
+            skipped = 0
+            while skipped < emitted:  # fast-forward past what we already yielded
+                next(it)
+                skipped += 1
+            for item in it:
+                yield item
+                emitted += 1
+                attempt = 0
+            return
+        except StopIteration:
+            # source shrank below the fast-forward point — nothing to resume
+            return
+        except policy.retry_on as e:
+            if attempt >= policy.max_retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(policy.delay_s(attempt))
+            attempt += 1

@@ -171,6 +171,12 @@ class ServeArgs:
     seed: int = 0
     #: append the engine stats JSON line to stdout after the results
     stats: bool = True
+    #: bounded queue depth — submissions past it backpressure (the CLI then
+    #: drains a micro-batch and resubmits); None = unbounded
+    max_queue: Optional[int] = None
+    #: per-request deadline in seconds; requests that wait longer complete
+    #: with a ``timed_out`` record instead of occupying a bucket slot
+    deadline_s: Optional[float] = None
 
 
 # -- the CLI ---------------------------------------------------------------
@@ -420,14 +426,23 @@ class CLI:
     def run_serve(self, values: Dict[str, Any]) -> list:
         """``serve --ckpt <dir>``: bucketed text generation over a saved
         model — prompts (file or stdin) → one JSON line per completion,
-        plus a final engine-stats line (docs/serving.md)."""
+        plus a final engine-stats line (docs/serving.md).
+
+        Error isolation (docs/reliability.md): an infeasible prompt (empty /
+        longer than the largest bucket) becomes a per-line
+        ``{"prompt": ..., "error": ...}`` record instead of aborting the
+        run; a bounded queue (``--serve.max_queue``) backpressures by
+        draining a micro-batch before resubmitting; timed-out or failed
+        requests surface their status per line.
+        """
         import json
         import time
 
         from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
-        from perceiver_io_tpu.inference.pipelines import TextGenerationPipeline
+        from perceiver_io_tpu.inference.generate import GenerationConfig
+        from perceiver_io_tpu.inference.samplers import SamplingConfig
         from perceiver_io_tpu.models import model_for_config
-        from perceiver_io_tpu.serving import BucketTable
+        from perceiver_io_tpu.serving import BucketTable, QueueFull, ServingEngine
         from perceiver_io_tpu.training.checkpoint import load_pretrained
 
         ckpt = values.get("ckpt") or values.get("params")
@@ -455,18 +470,23 @@ class CLI:
                 prompt_lens=tuple(args.prompt_buckets or table.prompt_lens),
                 batch_sizes=tuple(args.batch_buckets),
             )
-        pipe = TextGenerationPipeline(
-            model, params, ByteTokenizer(padding_side="left"),
-            bucketing=True, bucket_table=table,
-        )
-        gen_kwargs = dict(
+        tok = ByteTokenizer(padding_side="left")
+        gen_cfg = GenerationConfig(
             max_new_tokens=args.max_new_tokens,
             num_latents=args.num_latents,
-            temperature=args.temperature,
+            pad_token_id=tok.pad_token_id or 0,
+            eos_token_id=tok.eos_token_id,
+            sampling=SamplingConfig(temperature=args.temperature),
+        )
+        engine = ServingEngine(
+            model, params, gen_cfg, table,
+            rng=jax.random.PRNGKey(args.seed),
+            max_queue=args.max_queue,
+            default_deadline_s=args.deadline_s,
         )
         if args.warmup:
             t0 = time.monotonic()
-            compiles = pipe.warmup(**gen_kwargs)
+            compiles = engine.warmup()
             print(
                 f"[serve] warmup compiled {compiles} executors in "
                 f"{time.monotonic() - t0:.1f}s", file=sys.stderr, flush=True,
@@ -481,17 +501,39 @@ class CLI:
             raise SystemExit("serve: no prompts (empty file/stdin)")
 
         t0 = time.monotonic()
-        texts = pipe(
-            prompts, seed=args.seed, return_full_text=False, **gen_kwargs
-        )
+        pad_id = tok.pad_token_id or 0
+        handles: list = []  # (prompt, ServeRequest | None, error | None)
+        for p in prompts:
+            ids = np.asarray(tok.encode(p), np.int32)
+            try:
+                # backpressure: make room BEFORE submitting so a full queue
+                # drains a micro-batch instead of tripping the shed counter
+                # (shed should count true rejections, not this retry loop)
+                while not engine.health()["ready"] and engine.step():
+                    pass
+                handles.append((p, engine.submit(ids), None))
+            except (ValueError, QueueFull) as e:
+                # reject this line, keep serving the rest
+                handles.append((p, None, f"{type(e).__name__}: {e}"))
+        engine.drain()
         wall_s = time.monotonic() - t0
-        results = [
-            {"prompt": p, "completion": t} for p, t in zip(prompts, texts)
-        ]
+
+        results = []
+        for p, req, error in handles:
+            if req is not None and req.status == "ok":
+                completion = tok.decode([t for t in req.result.tolist() if t != pad_id])
+                results.append({"prompt": p, "completion": completion})
+            else:
+                results.append({
+                    "prompt": p,
+                    "error": error if req is None else (req.error or req.status),
+                    "status": "rejected" if req is None else req.status,
+                })
         for row in results:
             print(json.dumps(row), flush=True)
         if args.stats:
-            stats = pipe.serving_stats() or {}
+            stats = engine.stats()
+            stats["health"] = engine.health()
             stats["wall_s"] = round(wall_s, 3)
             print(json.dumps({"serve_stats": stats}), flush=True)
         return results
@@ -501,7 +543,8 @@ class CLI:
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --config=<yaml> --data=<name> --ckpt=<dir>")
         print("serve: --ckpt=<dir> --serve.prompts=<file|stdin> --serve.max_new_tokens "
-              "--serve.prompt_buckets --serve.batch_buckets --serve.warmup")
+              "--serve.prompt_buckets --serve.batch_buckets --serve.warmup "
+              "--serve.max_queue --serve.deadline_s")
         print(f"data modules: {sorted(self.family.data_registry)}")
 
 

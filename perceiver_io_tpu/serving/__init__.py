@@ -2,8 +2,13 @@
 compiled generation executors (docs/serving.md). The first load-path layer
 between "a jitted ``generate()``" and "a service": ragged traffic lands on
 a small pre-compilable executor grid instead of retracing per exact shape.
+
+Hardened for load (docs/reliability.md): bounded queue with
+:class:`QueueFull` backpressure, per-request deadlines, per-request error
+isolation, graceful ``drain()``, and a ``health()`` readiness snapshot.
 """
+from perceiver_io_tpu.reliability import QueueFull
 from perceiver_io_tpu.serving.buckets import BucketTable
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine
 
-__all__ = ["BucketTable", "ServeRequest", "ServingEngine"]
+__all__ = ["BucketTable", "QueueFull", "ServeRequest", "ServingEngine"]

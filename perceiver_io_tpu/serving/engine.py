@@ -35,12 +35,22 @@ The engine is deliberately synchronous and single-owner: ``submit()``
 enqueues, ``step()`` drains one micro-batch, ``serve()`` is submit-all +
 drain. An async front end (HTTP/RPC) drives the same queue from its own
 loop; device work already serializes inside each compiled executor.
+
+Fault tolerance (docs/reliability.md): the queue is bounded (``max_queue``
+→ :class:`~perceiver_io_tpu.reliability.QueueFull` backpressure + a shed
+counter), requests carry deadlines (expired ones complete ``timed_out``
+instead of occupying a bucket slot), a failing request or executor marks
+only its own request(s) ``failed`` while the rest of the queue drains,
+``drain()`` is the graceful-shutdown path, and ``health()`` is the
+readiness snapshot a front end polls. All failure paths are drilled by the
+deterministic chaos harness (``reliability.chaos``) via the optional
+``chaos`` / ``clock`` hooks.
 """
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -51,23 +61,33 @@ from perceiver_io_tpu.inference.generate import (
     executor_cache_stats,
     generate,
 )
+from perceiver_io_tpu.reliability import QueueFull
 from perceiver_io_tpu.serving.buckets import BucketTable
 
 
 @dataclass
 class ServeRequest:
-    """One queued prompt and, after its micro-batch ran, its result row."""
+    """One queued prompt and, after its micro-batch ran, its outcome.
+
+    ``status`` is ``"queued"`` until the scheduler disposes of the request:
+    ``"ok"`` (``result`` holds the generated row), ``"timed_out"`` (deadline
+    expired before a bucket slot ran it), or ``"failed"`` (``error`` holds
+    the reason; its micro-batch peers are unaffected).
+    """
 
     request_id: int
     prompt: np.ndarray  # (len,) int32, unpadded
     config: GenerationConfig
     submitted_at: float
+    deadline_at: Optional[float] = None  # absolute, in engine-clock seconds
     started_at: Optional[float] = None
     result: Optional[np.ndarray] = None  # (max_new_tokens,) ids, pad after EOS
+    status: str = "queued"  # queued | ok | timed_out | failed
+    error: Optional[str] = None
 
     @property
     def done(self) -> bool:
-        return self.result is not None
+        return self.status != "queued"
 
 
 class ServingEngine:
@@ -81,10 +101,24 @@ class ServingEngine:
     :param table: the bucket grid; defaults to a powers-of-two grid up to
         the model's context length (:meth:`BucketTable.for_model`).
     :param rng: base PRNG key; each micro-batch uses a fresh split.
+    :param max_queue: bounded-queue depth; ``submit`` past it raises
+        :class:`QueueFull` and counts a shed. None = unbounded (offline use).
+    :param default_deadline_s: deadline applied to requests submitted without
+        an explicit ``deadline_s``; expired requests complete ``timed_out``.
+    :param clock: monotonic time source. Tests and the chaos harness pass a
+        :class:`~perceiver_io_tpu.reliability.FakeClock` so deadline expiry
+        is deterministic; production uses the default ``time.monotonic``.
+    :param chaos: optional fault-injection registry
+        (:class:`~perceiver_io_tpu.reliability.ChaosRegistry`); None skips
+        every hook.
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
-                 table: Optional[BucketTable] = None, *, rng: Optional[jax.Array] = None):
+                 table: Optional[BucketTable] = None, *, rng: Optional[jax.Array] = None,
+                 max_queue: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 chaos=None):
         self.model = model
         self.params = params
         self.config = config or GenerationConfig()
@@ -95,48 +129,138 @@ class ServingEngine:
                 f"prompt buckets {too_long} exceed the model context "
                 f"length {model.max_seq_len}"
             )
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self._clock = clock
+        self._chaos = chaos
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._queue: List[ServeRequest] = []
         self._next_id = 0
+        self._accepting = True
         self._cache0 = executor_cache_stats()
         self._waits_ms: List[float] = []
         self._batches = 0
         self._requests = 0
+        self._completed = 0
+        self._shed = 0
+        self._timed_out = 0
+        self._failed = 0
         self._tokens_generated = 0
         self._real_prompt_tokens = 0
         self._padded_prompt_tokens = 0
 
     # -- queue front --------------------------------------------------------
-    def submit(self, prompt, config: Optional[GenerationConfig] = None) -> ServeRequest:
-        """Enqueue one prompt (1-D token ids); returns its request handle."""
+    def submit(self, prompt, config: Optional[GenerationConfig] = None,
+               *, deadline_s: Optional[float] = None) -> ServeRequest:
+        """Enqueue one prompt (1-D token ids); returns its request handle.
+
+        Raises ``ValueError`` for infeasible prompts (empty, or longer than
+        the largest bucket / prefix capacity) at submit time — never inside
+        bucket packing — and :class:`QueueFull` when the bounded queue is at
+        ``max_queue`` (the request is shed and counted, not enqueued).
+        """
+        if not self._accepting:
+            raise RuntimeError("engine is draining; new submissions rejected")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("cannot serve an empty prompt")
+        if prompt.size > self.table.prompt_lens[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest bucket "
+                f"{self.table.prompt_lens[-1]}; extend the bucket table or "
+                "truncate the prompt"
+            )
         cfg = config or self.config
         self._pick_prompt_bucket(int(prompt.size), cfg)  # fail fast, not mid-batch
-        req = ServeRequest(self._next_id, prompt, cfg, time.monotonic())
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            self._shed += 1
+            raise QueueFull(
+                f"queue depth {len(self._queue)} is at max_queue="
+                f"{self.max_queue}; request shed — drain with step() or "
+                "retry after backoff"
+            )
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        now = self._clock()
+        req = ServeRequest(
+            self._next_id, prompt, cfg, now,
+            deadline_at=None if deadline_s is None else now + deadline_s,
+        )
         self._next_id += 1
         self._queue.append(req)
         self._requests += 1
         return req
 
     def serve(self, prompts: Sequence, config: Optional[GenerationConfig] = None,
-              *, rng: Optional[jax.Array] = None) -> List[np.ndarray]:
-        """Submit every prompt, drain the queue, return results in order."""
+              *, rng: Optional[jax.Array] = None) -> List[Optional[np.ndarray]]:
+        """Submit every prompt, drain the queue, return results in order.
+
+        This batch convenience API is STRICT about failures: a ``failed``
+        request (a real executor error, which ``step()`` isolates instead of
+        propagating) re-raises here so callers like the bucketed pipeline
+        surface the root cause instead of crashing on a None row. A
+        ``timed_out`` request's slot holds None (only reachable when the
+        engine has deadlines configured). Use ``submit``/``step``/``drain``
+        directly for per-request fault handling."""
         if rng is not None:
             self._rng = rng
         reqs = [self.submit(p, config) for p in prompts]
         self.run_until_idle()
+        failed = [r for r in reqs if r.status == "failed"]
+        if failed:
+            raise RuntimeError(
+                f"{len(failed)} of {len(reqs)} served requests failed; "
+                f"first error: {failed[0].error}"
+            )
         return [r.result for r in reqs]
 
     def run_until_idle(self) -> int:
-        """Drain the whole queue; returns the number of requests served."""
+        """Drain the whole queue; returns the number of requests disposed of
+        (completed + timed out + failed)."""
         served = 0
         while True:
             n = self.step()
             if n == 0:
                 return served
             served += n
+
+    def drain(self) -> int:
+        """Graceful shutdown: stop accepting submissions, run every queued
+        request to completion, return the number disposed of. Idempotent —
+        a second call is a no-op returning 0."""
+        self._accepting = False
+        return self.run_until_idle()
+
+    # -- fault disposition ---------------------------------------------------
+    def _finish(self, req: ServeRequest, status: str, *, error: Optional[str] = None) -> None:
+        req.status = status
+        req.error = error
+        if status == "ok":
+            self._completed += 1
+        elif status == "timed_out":
+            self._timed_out += 1
+        elif status == "failed":
+            self._failed += 1
+
+    def _expire_overdue(self) -> int:
+        """Complete every queue entry past its deadline as ``timed_out`` so
+        expired requests never occupy a bucket slot."""
+        now = self._clock()
+        live: List[ServeRequest] = []
+        expired = 0
+        for req in self._queue:
+            if req.deadline_at is not None and now >= req.deadline_at:
+                self._finish(
+                    req, "timed_out",
+                    error=f"deadline exceeded after {now - req.submitted_at:.3f}s in queue",
+                )
+                expired += 1
+            else:
+                live.append(req)
+        self._queue = live
+        return expired
 
     # -- scheduler ----------------------------------------------------------
     def _pick_prompt_bucket(self, length: int, cfg: GenerationConfig) -> int:
@@ -161,18 +285,48 @@ class ServingEngine:
     def step(self) -> int:
         """Run ONE micro-batch: the queue head plus following requests with
         the same config, packed FIFO into the next bucket slot. Returns the
-        number of real requests served (0 = queue empty)."""
+        number of requests disposed of — completed, timed out, or failed
+        (0 = queue empty).
+
+        Fault isolation: requests past their deadline finish ``timed_out``
+        before packing; a chaos-injected per-request fault finishes only
+        that request ``failed``; an exception out of the executor (real or
+        injected) fails every request in this micro-batch but leaves the
+        rest of the queue intact.
+        """
+        disposed = self._expire_overdue()
         if not self._queue:
-            return 0
+            return disposed
         cfg = self._queue[0].config
         picked: List[ServeRequest] = []
         rest: List[ServeRequest] = []
         for req in self._queue:
-            if len(picked) < self.table.batch_sizes[-1] and req.config == cfg:
-                picked.append(req)
-            else:
+            if len(picked) >= self.table.batch_sizes[-1] or req.config != cfg:
                 rest.append(req)
+                continue
+            fault = self._chaos.hit("serving.request", req.request_id) if self._chaos else None
+            if fault is not None and fault.kind == "error":
+                self._finish(req, "failed", error=str(fault.make_error()))
+                disposed += 1
+                continue
+            if fault is not None and fault.kind == "hang":
+                # A hung request stalls its slot: advance the injectable
+                # clock (FakeClock; a real monotonic clock can't be moved)
+                # and re-check the deadline it just burned through.
+                advance = getattr(self._clock, "advance", None)
+                if advance is not None:
+                    advance(fault.delay_s)
+                if req.deadline_at is not None and self._clock() >= req.deadline_at:
+                    self._finish(
+                        req, "timed_out",
+                        error=f"hung for {fault.delay_s}s past its deadline",
+                    )
+                    disposed += 1
+                    continue
+            picked.append(req)
         self._queue = rest
+        if not picked:
+            return disposed
 
         b = self.table.batch_bucket(len(picked))
         length = self._pick_prompt_bucket(max(r.prompt.size for r in picked), cfg)
@@ -185,7 +339,7 @@ class ServingEngine:
         # to the slow windowed-recompute plan. Attention is per-row; filler
         # content never touches real rows.
         pad_count = np.zeros((b,), np.int32)
-        now = time.monotonic()
+        now = self._clock()
         for i, req in enumerate(picked):
             ids[i, length - req.prompt.size:] = req.prompt
             pad_count[i] = length - req.prompt.size
@@ -193,19 +347,29 @@ class ServingEngine:
             self._waits_ms.append((now - req.submitted_at) * 1e3)
 
         self._rng, key = jax.random.split(self._rng)
-        out = np.asarray(
-            generate(
-                self.model, self.params, jnp.asarray(ids), cfg,
-                rng=key, prompt_pad_count=jnp.asarray(pad_count),
+        self._batches += 1
+        try:
+            batch_fault = self._chaos.hit("serving.batch") if self._chaos else None
+            if batch_fault is not None and batch_fault.kind == "error":
+                raise batch_fault.make_error()
+            out = np.asarray(
+                generate(
+                    self.model, self.params, jnp.asarray(ids), cfg,
+                    rng=key, prompt_pad_count=jnp.asarray(pad_count),
+                )
             )
-        )
+        except Exception as e:
+            # Executor failure: this micro-batch fails, the queue survives.
+            for req in picked:
+                self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
+            return disposed + len(picked)
         for i, req in enumerate(picked):
             req.result = out[i]
-        self._batches += 1
+            self._finish(req, "ok")
         self._tokens_generated += len(picked) * cfg.max_new_tokens
         self._real_prompt_tokens += sum(int(r.prompt.size) for r in picked)
         self._padded_prompt_tokens += b * length
-        return len(picked)
+        return disposed + len(picked)
 
     # -- ahead-of-time warmup ----------------------------------------------
     def warmup(self, config: Optional[GenerationConfig] = None) -> int:
@@ -256,6 +420,10 @@ class ServingEngine:
             "requests": self._requests,
             "batches": self._batches,
             "queued": len(self._queue),
+            "completed": self._completed,
+            "shed": self._shed,
+            "timed_out": self._timed_out,
+            "failed": self._failed,
             "compiles": cache["misses"],
             "executor_cache": cache,
             "queue_wait_ms": {"p50": pct(50.0), "p95": pct(95.0)},
@@ -267,4 +435,25 @@ class ServingEngine:
                 "prompt_lens": list(self.table.prompt_lens),
                 "batch_sizes": list(self.table.batch_sizes),
             },
+        }
+
+    def health(self) -> dict:
+        """Readiness snapshot for a serving front end: ``ready`` means the
+        engine accepts a submission right now (not draining, queue below
+        ``max_queue``). Cheap — no device work, no cache reads."""
+        now = self._clock()
+        depth = len(self._queue)
+        return {
+            "ready": self._accepting
+            and (self.max_queue is None or depth < self.max_queue),
+            "accepting": self._accepting,
+            "queue_depth": depth,
+            "max_queue": self.max_queue,
+            "oldest_wait_ms": round(
+                max((now - r.submitted_at) for r in self._queue) * 1e3, 3
+            ) if self._queue else 0.0,
+            "completed": self._completed,
+            "shed": self._shed,
+            "timed_out": self._timed_out,
+            "failed": self._failed,
         }

@@ -154,7 +154,11 @@ class ResumeCheckpointManager:
         )
 
     def close(self):
-        self._manager.close()
+        """Idempotent: crash-path cleanup (trainer ``finally`` blocks) may
+        race a normal close — the second call is a no-op."""
+        if self._manager is not None:
+            self._manager.close()
+            self._manager = None
 
 
 class BestCheckpointManager:
@@ -205,4 +209,7 @@ class BestCheckpointManager:
         return params, (config_from_dict(None, d) if d is not None else None)
 
     def close(self):
-        self._manager.close()
+        """Idempotent — see :meth:`ResumeCheckpointManager.close`."""
+        if self._manager is not None:
+            self._manager.close()
+            self._manager = None

@@ -17,7 +17,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import time
+import traceback
+from collections import deque
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 import jax
@@ -84,8 +87,30 @@ class TrainerConfig:
     #: and before every TrainState snapshot (a diverged state is never
     #: snapshotted, so existing snapshots stay a finite resume point); the
     #: device queue is never stalled per-step (Lightning ``detect_anomaly``
-    #: role)
+    #: role). ``False`` disables all non-finite handling (policy ``off``)
+    #: unless ``non_finite_policy`` is explicitly skip/rollback.
     terminate_on_non_finite: bool = True
+    #: what a non-finite train loss does (docs/reliability.md):
+    #: ``halt`` (raise at the log flush — the historical behavior),
+    #: ``skip`` (discard that step's update, keep the last-good state, count
+    #: it in ``Trainer.fault_stats``), or ``rollback`` (skip, and after
+    #: ``non_finite_rollback_after`` consecutive bad steps restore the latest
+    #: finite TrainState snapshot and fast-forward the data stream — requires
+    #: ``save_state_every_n_steps``). skip/rollback check the loss every step
+    #: (one device fetch per step) and force ``steps_per_execution=1``
+    #: scheduling, trading dispatch throughput for recoverability. NOTE:
+    #: rollback pins roughly ``save_state_every_n_steps +
+    #: non_finite_rollback_after`` recent batches in host memory (the
+    #: exact-replay buffer) — budget the snapshot cadence accordingly
+    #: (e.g. a 5000-step cadence with 2 MB batches pins ~10 GB host RAM).
+    non_finite_policy: str = "halt"
+    #: K consecutive non-finite steps trigger the policy's escalation:
+    #: ``rollback`` restores the latest snapshot, ``skip`` halts (a streak
+    #: that long is persistent divergence, not a transient fault)
+    non_finite_rollback_after: int = 3
+    #: give up (raise) after this many rollbacks in one fit — a persistent
+    #: divergence is a hyperparameter problem, not a transient fault
+    non_finite_max_rollbacks: int = 3
 
 
 #: steps traced per jax.profiler capture: [profile_start, profile_start + _PROFILE_WINDOW)
@@ -125,13 +150,116 @@ def _params_finite(params) -> jnp.ndarray:
     return jnp.all(jnp.stack(leaves)) if leaves else jnp.asarray(True)
 
 
+def _effective_non_finite_policy(cfg: TrainerConfig) -> str:
+    """halt | skip | rollback | off. ``terminate_on_non_finite=False`` keeps
+    its historical meaning (no checks at all) unless the new policy field is
+    explicitly set to a recovering mode."""
+    if cfg.non_finite_policy not in ("halt", "skip", "rollback"):
+        raise ValueError(
+            f"non_finite_policy must be halt|skip|rollback, got "
+            f"{cfg.non_finite_policy!r}"
+        )
+    if cfg.non_finite_policy != "halt":
+        return cfg.non_finite_policy
+    return "halt" if cfg.terminate_on_non_finite else "off"
+
+
+class _BatchStream:
+    """The trainer's seekable view of ``train_data``: cycles on exhaustion
+    (rejecting one-shot generators), counts batches handed out
+    (``position``, 0-based), fast-forwards to a resume point, and — when a
+    replay buffer is enabled — rewinds to a recent position so the rollback
+    policy replays the exact batches the rolled-back steps consumed.
+
+    The rewind never touches the underlying iterable: handed-out batches are
+    retained in a bounded deque and replayed from memory, after which the
+    live iterator resumes exactly where it left off. That keeps rollback
+    correct for *any* iterable (lists, loaders, streaming pipelines) at the
+    cost of ``replay_buffer`` batches of host memory.
+    """
+
+    def __init__(self, data: Iterable, *, replay_buffer: int = 0):
+        self._data = data
+        self._iter = iter(data)
+        self.position = 0  # index of the next batch next() hands out
+        self._pulled = 0  # batches pulled off the underlying iterator
+        self._buffer: Optional[deque] = (
+            deque(maxlen=replay_buffer) if replay_buffer > 0 else None
+        )
+        self._replay: deque = deque()
+
+    def _pull(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = iter(self._data)
+            try:
+                return next(self._iter)
+            except StopIteration:
+                raise ValueError(
+                    "train_data is exhausted and not re-iterable "
+                    "(one-shot generator?); pass a list or a loader"
+                ) from None
+
+    def next(self):
+        if self._replay:
+            pos, batch = self._replay.popleft()
+            self.position = pos + 1
+            return batch
+        batch = self._pull()
+        if self._buffer is not None:
+            self._buffer.append((self.position, batch))
+        self.position += 1
+        self._pulled = self.position
+        return batch
+
+    def fast_forward(self, n: int) -> None:
+        """Position a FRESH stream so the next batch is batch ``n`` — the
+        resume replay. Loaders with a ``skip_batches`` hook jump in O(1);
+        anything else is consumed batch by batch."""
+        if n <= 0:
+            return
+        if hasattr(self._data, "skip_batches") and hasattr(self._data, "__len__"):
+            self._data.skip_batches(n)
+            self._iter = iter(self._data)
+            self.position = self._pulled = n
+        else:
+            for _ in range(n):
+                self.next()
+
+    def rewind_to(self, n: int) -> None:
+        """Re-position so the next batch handed out is batch ``n`` again,
+        replaying retained batches (rollback fast-forward). Everything
+        already pulled off the underlying iterator — including batches ahead
+        of ``position`` left over from an earlier rewind — must replay from
+        the buffer, because the live iterator cannot be stepped back."""
+        if n > self.position:
+            raise ValueError(f"rewind_to({n}) is ahead of position {self.position}")
+        entries = dict(self._buffer or ())
+        entries.update(self._replay)
+        wanted = sorted((p, b) for p, b in entries.items() if p >= n)
+        if [p for p, _ in wanted] != list(range(n, self._pulled)):
+            raise RuntimeError(
+                f"rollback to batch {n} exceeds the replay buffer (retained "
+                f"{[p for p, _ in wanted]}, pulled {self._pulled}); raise the "
+                "snapshot cadence coverage or lower non_finite_rollback_after"
+            )
+        self._replay = deque(wanted)
+        self.position = n
+
+
 class Trainer:
     """Step-based fit/validate driver.
 
     :param loss_fn: ``(params, batch, rng) -> (loss, metrics)`` (one of
         :mod:`perceiver_io_tpu.training.tasks`).
     :param callbacks: callables ``(trainer, state, step, val_metrics)`` run on
-        process 0 after each validation pass.
+        process 0 after each validation pass. A raising callback is logged
+        and counted (``fault_stats["callback_errors"]``), never fatal.
+    :param chaos: optional fault-injection registry
+        (:class:`~perceiver_io_tpu.reliability.ChaosRegistry`); consulted
+        once per optimizer step at the ``trainer.step`` site. None (the
+        default) skips the hook entirely.
     """
 
     def __init__(
@@ -144,6 +272,7 @@ class Trainer:
         model_config: Any = None,
         lr_schedule: Optional[optax.Schedule] = None,
         callbacks: Sequence[Callable] = (),
+        chaos=None,
     ):
         self.config = config
         self.mesh = mesh
@@ -158,6 +287,10 @@ class Trainer:
         self._eval_step = None
         self._tb = None
         self._metrics_file = None
+        self._chaos = chaos
+        self._policy = _effective_non_finite_policy(config)
+        #: fault-recovery counters for this trainer's lifetime
+        self.fault_stats = {"skipped_steps": 0, "rollbacks": 0, "callback_errors": 0}
 
         if config.enable_checkpointing:
             # Created on EVERY process: orbax save of multi-host sharded
@@ -166,26 +299,46 @@ class Trainer:
                 os.path.join(config.default_root_dir, "checkpoints"),
                 max_to_keep=config.max_checkpoints,
             )
-        if self.is_main_process:
-            os.makedirs(config.default_root_dir, exist_ok=True)
-            self._metrics_file = open(
-                os.path.join(config.default_root_dir, "metrics.jsonl"), "a"
-            )
-            if config.enable_tensorboard:
-                try:
-                    from torch.utils.tensorboard import SummaryWriter
-
-                    self._tb = SummaryWriter(os.path.join(config.default_root_dir, "tb"))
-                except Exception:
-                    self._tb = None
+        self._open_writers()
 
     @property
     def is_main_process(self) -> bool:
         """``rank_zero_only`` parity (reference ``clm/lightning.py:113``)."""
         return jax.process_index() == 0
 
-    def log_metrics(self, step: int, metrics: dict, prefix: str = "") -> None:
+    def _open_writers(self) -> None:
+        """(Re)open the rank-0 metrics JSONL + TensorBoard writers — called
+        at construction and again by ``fit`` after a previous fit closed
+        them (metrics.jsonl is append-mode, so re-fitting appends)."""
         if not self.is_main_process:
+            return
+        cfg = self.config
+        os.makedirs(cfg.default_root_dir, exist_ok=True)
+        if self._metrics_file is None:
+            self._metrics_file = open(
+                os.path.join(cfg.default_root_dir, "metrics.jsonl"), "a"
+            )
+        if cfg.enable_tensorboard and self._tb is None:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(os.path.join(cfg.default_root_dir, "tb"))
+            except Exception:
+                self._tb = None
+
+    def _close_writers(self) -> None:
+        """Deterministically flush + close metrics.jsonl and the TensorBoard
+        writer (idempotent) — ``fit`` calls this on every exit path so a
+        crashed run still leaves complete, closed log files."""
+        if self._metrics_file is not None:
+            self._metrics_file.close()
+            self._metrics_file = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+    def log_metrics(self, step: int, metrics: dict, prefix: str = "") -> None:
+        if not self.is_main_process or self._metrics_file is None:
             return
         scalars = {f"{prefix}{k}": float(v) for k, v in metrics.items()}
         self._metrics_file.write(json.dumps({"step": step, **scalars}) + "\n")
@@ -197,7 +350,7 @@ class Trainer:
     def log_text(self, step: int, tag: str, text: str) -> None:
         """Qualitative text logging (generated samples, filled masks) — the
         reference renders these into TensorBoard text panels."""
-        if not self.is_main_process:
+        if not self.is_main_process or self._metrics_file is None:
             return
         self._metrics_file.write(json.dumps({"step": step, tag: text}) + "\n")
         self._metrics_file.flush()
@@ -232,6 +385,7 @@ class Trainer:
         # where the preempted run stopped.
         prev_handler = None
         self._preempted = False
+        self._open_writers()  # re-fit after a closed fit reopens (append)
         if cfg.save_state_every_n_steps is not None:
 
             def _on_sigterm(signum, frame):
@@ -248,12 +402,23 @@ class Trainer:
                 cfg, init_params_fn, train_data, val_data, initial_params
             )
         finally:
+            # deterministic log teardown: metrics.jsonl and the TB writer are
+            # complete and closed on every exit path, crash included
+            self._close_writers()
             if prev_handler is not None:
                 import signal
 
                 signal.signal(signal.SIGTERM, prev_handler)
 
     def _fit_inner(self, cfg, init_params_fn, train_data, val_data, initial_params):
+        if self._policy == "rollback" and cfg.save_state_every_n_steps is None:
+            # validate before any compile so the misconfiguration fails in
+            # milliseconds, not after state setup
+            raise ValueError(
+                "non_finite_policy='rollback' requires "
+                "save_state_every_n_steps (it restores the latest "
+                "TrainState snapshot)"
+            )
         self.setup_state(init_params_fn, initial_params=initial_params)
         train_step = make_train_step(
             self.loss_fn,
@@ -261,6 +426,10 @@ class Trainer:
             self._shardings,
             grad_clip_norm=cfg.grad_clip_norm,
             grad_accum_steps=cfg.grad_accum_steps,
+            # skip/rollback may hand the PRE-step state back to the loop, so
+            # its buffers must survive the step: no donation (the same 2×
+            # state memory the discarded update would have freed)
+            donate=self._policy not in ("skip", "rollback"),
         )
         rng = jax.random.PRNGKey(cfg.seed)
 
@@ -284,38 +453,45 @@ class Trainer:
             resume_mgr = ResumeCheckpointManager(
                 os.path.join(cfg.default_root_dir, "resume")
             )
+        if self._policy == "rollback":
+            stale = resume_mgr.latest_step
+            if stale is not None and stale > start_step - 1:
+                # snapshots AHEAD of this run's start can only come from a
+                # previous run into the same root; restoring one mid-rollback
+                # would graft a foreign trajectory onto this run
+                raise ValueError(
+                    f"{os.path.join(cfg.default_root_dir, 'resume')} holds a "
+                    f"step-{stale} snapshot from a previous run (this run "
+                    f"starts at step {start_step}); pass resume= to continue "
+                    "that run, or point default_root_dir at a fresh directory"
+                )
+            if stale is None:
+                # guarantee a restore point exists even before the first
+                # periodic save — a divergence inside the first save window
+                # rolls back to the (finite) initial state
+                resume_mgr.save(start_step - 1, self.state)
 
-        data_iter = iter(train_data)
-
-        def next_batch():
-            nonlocal data_iter
-            try:
-                return next(data_iter)
-            except StopIteration:
-                data_iter = iter(train_data)
-                try:
-                    return next(data_iter)
-                except StopIteration:
-                    raise ValueError(
-                        "train_data is exhausted and not re-iterable "
-                        "(one-shot generator?); pass a list or a loader"
-                    ) from None
+        # rollback replays at most one save window plus the bad streak; keep
+        # that many handed-out batches replayable (plus slack for the fused
+        # block the streak may start inside)
+        replay = 0
+        if self._policy == "rollback":
+            replay = (
+                cfg.save_state_every_n_steps
+                + cfg.non_finite_rollback_after
+                + cfg.steps_per_execution
+                + 1
+            )
+        stream = _BatchStream(train_data, replay_buffer=replay)
 
         # Replay the data stream to the resume point so a resumed run sees
-        # the same batches the uninterrupted run would. Loaders with a
-        # ``skip_batches`` hook (data.loader.DataLoader) fast-forward in
-        # O(1); anything else is consumed batch by batch.
-        if start_step > 1:
-            if hasattr(train_data, "skip_batches") and hasattr(train_data, "__len__"):
-                train_data.skip_batches(start_step - 1)
-                data_iter = iter(train_data)
-            else:
-                for _ in range(start_step - 1):
-                    next_batch()
+        # the same batches the uninterrupted run would (batch n drives step
+        # n + 1).
+        stream.fast_forward(start_step - 1)
 
         try:
             self._fit_loop(
-                cfg, train_step, rng, next_batch, val_data, resume_mgr, start_step
+                cfg, train_step, rng, stream, val_data, resume_mgr, start_step
             )
         finally:
             # even a crashed step must not leak the snapshot manager (the
@@ -331,6 +507,9 @@ class Trainer:
         the profiler capture window."""
         if start + k - 1 > cfg.max_steps or self._preempted:
             return False
+        if self._policy in ("skip", "rollback"):
+            # recovering policies check (and may discard) every step singly
+            return False
         for idx in range(start, start + k - 1):
             if resume_mgr is not None and idx % cfg.save_state_every_n_steps == 0:
                 return False
@@ -342,12 +521,57 @@ class Trainer:
                 return False
         return True
 
+    def _chaos_step_metrics(self, metrics: dict) -> dict:
+        """Consult the chaos registry once per optimizer step; a ``nan``
+        fault corrupts the reported loss (driving the non-finite policies),
+        an ``error`` fault raises at the step boundary."""
+        fault = self._chaos.hit("trainer.step")
+        if fault is None:
+            return metrics
+        if fault.kind == "error":
+            raise fault.make_error()
+        if fault.kind == "nan":
+            metrics = dict(metrics)
+            metrics["loss"] = float("nan")
+        return metrics
+
+    def _rollback(self, cfg, stream, resume_mgr, step_idx: int) -> int:
+        """Restore the latest finite TrainState snapshot and rewind the data
+        stream to it; returns the step index to resume from. Raises after
+        ``non_finite_max_rollbacks`` — persistent divergence is a
+        hyperparameter problem, not a transient fault."""
+        self._rollbacks_this_fit += 1
+        if self._rollbacks_this_fit > cfg.non_finite_max_rollbacks:
+            raise FloatingPointError(
+                f"train loss stayed non-finite through "
+                f"{cfg.non_finite_max_rollbacks} rollbacks (last at step "
+                f"{step_idx}); halting — lower the lr / tighten grad clip"
+            )
+        snap_step = resume_mgr.latest_step
+        if snap_step is None or snap_step > stream.position:
+            raise RuntimeError(
+                f"rollback found no usable snapshot (latest={snap_step}, "
+                f"stream position={stream.position}) — the resume dir was "
+                "modified mid-run?"
+            )
+        self.state = resume_mgr.restore_latest(self.state)
+        stream.rewind_to(snap_step)
+        self.fault_stats["rollbacks"] += 1
+        self.log_metrics(
+            step_idx,
+            {"rollback_to_step": snap_step, "rollbacks": self.fault_stats["rollbacks"]},
+        )
+        return snap_step + 1
+
     def _fit_loop(
-        self, cfg, train_step, rng, next_batch, val_data, resume_mgr, start_step
+        self, cfg, train_step, rng, stream, val_data, resume_mgr, start_step
     ) -> None:
         window: list = []
         profiling = False
         t0 = time.time()
+        self._bad_streak = 0
+        self._rollbacks_this_fit = 0
+        snap_after_recovery = False
         k_exec = cfg.steps_per_execution
         multi_step = None
         if k_exec > 1:
@@ -366,7 +590,7 @@ class Trainer:
                     cfg, step_idx, k_exec, val_data, resume_mgr
                 ):
                     # one device program for k_exec steps (amortized dispatch)
-                    block = [next_batch() for _ in range(k_exec)]
+                    block = [stream.next() for _ in range(k_exec)]
                     _check_uniform_block(block, k_exec)
                     stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *block)
                     stacked = shard_or_assemble(
@@ -382,7 +606,7 @@ class Trainer:
                     ]
                     n_ran = k_exec
                 else:
-                    batch = next_batch()
+                    batch = stream.next()
                     # fold_in (not sequential split): step k's rng is a pure
                     # function of (seed, k), so a resumed run replays the
                     # identical dropout/augmentation stream
@@ -395,6 +619,9 @@ class Trainer:
                             os.path.join(cfg.default_root_dir, "profile")
                         )
                         profiling = True
+                    prev_state = (
+                        self.state if self._policy in ("skip", "rollback") else None
+                    )
                     self.state, metrics = train_step(self.state, batch, step_rng)
                     per_step = [metrics]
                     n_ran = 1
@@ -402,6 +629,60 @@ class Trainer:
                         jax.block_until_ready(metrics["loss"])
                         jax.profiler.stop_trace()
                         profiling = False
+
+                if self._chaos is not None:
+                    per_step = [self._chaos_step_metrics(m) for m in per_step]
+
+                if self._policy in ("skip", "rollback"):
+                    # per-step divergence check (one device fetch per step —
+                    # the price of recoverability; halt keeps the lazy path)
+                    if not np.isfinite(float(per_step[0].get("loss", 0.0))):
+                        self._bad_streak += 1
+                        if self._bad_streak >= cfg.non_finite_rollback_after:
+                            if self._policy == "rollback":
+                                step_idx = self._rollback(
+                                    cfg, stream, resume_mgr, step_idx
+                                )
+                                self._bad_streak = 0
+                                window, t0 = [], time.time()
+                                if resume_mgr is not None and self._preempted:
+                                    # post-rollback state IS the snapshot —
+                                    # nothing new to persist before exiting
+                                    self.log_metrics(
+                                        step_idx, {"preempted_at": step_idx}
+                                    )
+                                    break
+                                continue
+                            # K consecutive bad steps under skip is persistent
+                            # divergence, not a transient — and the last-good
+                            # state skip reverts to may itself hide an earlier
+                            # finite-loss overflow; stop burning the budget
+                            raise FloatingPointError(
+                                f"train loss non-finite for {self._bad_streak} "
+                                f"consecutive steps (last at step {step_idx}) "
+                                "under non_finite_policy='skip'; halting — "
+                                "lower the lr / tighten grad clip, or use "
+                                "'rollback' with snapshots"
+                            )
+                        # skip: discard the bad update, keep last-good state
+                        self.state = prev_state
+                        self.fault_stats["skipped_steps"] += 1
+                        snap_after_recovery = True
+                        self.log_metrics(
+                            step_idx,
+                            {"non_finite_skipped": self.fault_stats["skipped_steps"]},
+                        )
+                        if resume_mgr is not None and self._preempted:
+                            # preemption during a bad streak: persist the
+                            # last-good state (if it is in fact finite) and
+                            # exit before the platform's hard kill
+                            if _params_finite(self.state.params):
+                                resume_mgr.save(step_idx, self.state)
+                            self.log_metrics(step_idx, {"preempted_at": step_idx})
+                            break
+                        step_idx += 1
+                        continue
+                    self._bad_streak = 0
 
                 for m in per_step:
                     window.append(m)
@@ -418,17 +699,19 @@ class Trainer:
                     mean["steps_per_sec"] = len(window) / (time.time() - t0)
                     self.log_metrics(step_idx, mean, prefix="train/")
                     window, t0 = [], time.time()
-                    if cfg.terminate_on_non_finite and not np.isfinite(
+                    if self._policy == "halt" and not np.isfinite(
                         mean.get("loss", 0.0)
                     ):
                         raise FloatingPointError(
                             f"train loss went non-finite at step {step_idx} "
                             f"({mean['loss']}); halting — resume from the last "
-                            "snapshot with a lower lr / grad clip"
+                            "snapshot with a lower lr / grad clip, or set "
+                            "non_finite_policy=skip|rollback to recover in place"
                         )
 
                 if (
-                    step_idx % cfg.log_every_n_steps < n_ran
+                    window
+                    and step_idx % cfg.log_every_n_steps < n_ran
                     and step_idx >= cfg.log_every_n_steps
                 ):
                     flush_window()
@@ -436,19 +719,27 @@ class Trainer:
                 if resume_mgr is not None and (
                     step_idx % cfg.save_state_every_n_steps == 0
                     or self._preempted
+                    or snap_after_recovery
                 ):
                     # the loss is computed on PRE-update params, so it can
                     # be finite while the update just overflowed — check the
                     # post-update state itself before persisting it
-                    if cfg.terminate_on_non_finite and not _params_finite(
-                        self.state.params
-                    ):
+                    if self._policy == "off" or _params_finite(self.state.params):
+                        resume_mgr.save(step_idx, self.state)
+                        snap_after_recovery = False
+                    elif self._policy == "rollback":
+                        # don't kill a run whose own policy can recover: skip
+                        # the save (existing snapshots stay finite) and let
+                        # the next step's non-finite loss trigger rollback
+                        self.log_metrics(
+                            step_idx, {"snapshot_refused_non_finite": step_idx}
+                        )
+                    else:
                         raise FloatingPointError(
                             f"params went non-finite by step {step_idx}; "
                             "snapshot refused — resume from the previous "
                             "snapshot with a lower lr / grad clip"
                         )
-                    resume_mgr.save(step_idx, self.state)
                 if resume_mgr is not None and self._preempted:
                     self.log_metrics(step_idx, {"preempted_at": step_idx})
                     break
@@ -467,7 +758,25 @@ class Trainer:
                         )
                     for cb in self.callbacks:
                         if self.is_main_process:
-                            cb(self, self.state, step_idx, val_metrics)
+                            # a broken qualitative-sampling callback must not
+                            # kill a multi-hour run: log the traceback, count
+                            # it, keep training
+                            try:
+                                cb(self, self.state, step_idx, val_metrics)
+                            except Exception:
+                                self.fault_stats["callback_errors"] += 1
+                                name = getattr(cb, "__name__", repr(cb))
+                                print(
+                                    f"[trainer] validation callback {name} "
+                                    f"failed at step {step_idx}:\n"
+                                    f"{traceback.format_exc()}",
+                                    file=sys.stderr,
+                                    flush=True,
+                                )
+                                self.log_metrics(
+                                    step_idx,
+                                    {"callback_errors": self.fault_stats["callback_errors"]},
+                                )
                     t0 = time.time()
                 step_idx += 1
             if profiling:  # max_steps ended inside the capture window
@@ -526,9 +835,9 @@ class Trainer:
         return {k: v / max(1, count) for k, v in totals.items()}
 
     def close(self):
+        """Release checkpoint managers and log writers (idempotent; ``fit``
+        already closed the writers on its way out)."""
         if self._ckpt is not None:
             self._ckpt.close()
-        if self._tb is not None:
-            self._tb.close()
-        if self._metrics_file is not None:
-            self._metrics_file.close()
+            self._ckpt = None
+        self._close_writers()

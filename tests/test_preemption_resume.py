@@ -1,0 +1,160 @@
+"""Hard-preemption resume: a training run killed mid-stream with SIGKILL —
+no grace, no SIGTERM snapshot, the process just vanishes like a reclaimed
+TPU VM — must resume from its last periodic snapshot onto the *step-identical*
+loss trajectory of an uninterrupted run.
+
+One worker script runs in three subprocess modes (straight / kill / resume)
+so all three trajectories execute byte-identical training code; the kill is
+self-inflicted from the data source at a deterministic batch, so the test
+never races a timer. Complements ``test_resume.py``'s in-process SIGTERM
+test with the ungraceful case + cross-process trajectory comparison.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow, pytest.mark.timeout(560)]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import signal
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from perceiver_io_tpu.models.text.clm import (
+        CausalLanguageModel, CausalLanguageModelConfig,
+    )
+    from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+    from perceiver_io_tpu.training.tasks import clm_loss_fn
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    mode, root = sys.argv[1], sys.argv[2]
+    VOCAB, SEQ, LATENTS = 32, 16, 8
+    KILL_AT_BATCH = 5  # SIGKILL while fetching step 5's batch: steps 1-4 ran
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=SEQ, max_latents=LATENTS, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config=cfg)
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(6):
+        ids = rng.integers(0, VOCAB, (4, SEQ + 1), dtype=np.int64)
+        batches.append({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+
+    class HardKiller:
+        # re-iterable source that SIGKILLs its own process mid-fetch —
+        # an ungraceful preemption, deterministic down to the batch
+        def __init__(self, batches):
+            self.batches = batches
+            self.served = 0
+
+        def __iter__(self):
+            for b in self.batches:
+                self.served += 1
+                if self.served == KILL_AT_BATCH:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                yield b
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=8, val_check_interval=10_000, log_every_n_steps=1,
+            default_root_dir=root, enable_checkpointing=False,
+            enable_tensorboard=False, seed=7,
+            save_state_every_n_steps=2 if mode in ("kill", "resume") else None,
+            resume=sys.argv[3] if mode == "resume" else None,
+        ),
+        make_mesh(MeshConfig(data=1)),
+        clm_loss_fn(model, LATENTS),
+        optax.adamw(1e-3),
+        model_config=cfg,
+    )
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS,
+        )["params"]
+
+    data = HardKiller(batches) if mode == "kill" else batches
+    state = trainer.fit(init_params, data)
+    trainer.close()
+    print(f"DONE step={int(state.step)}")
+    """
+)
+
+
+def _run_worker(script, mode, root, resume_from=None):
+    argv = [sys.executable, script, mode, str(root)]
+    if resume_from is not None:
+        argv.append(str(resume_from))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        argv, cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=480
+    )
+
+
+def _losses(root):
+    """step -> train/loss from a run's metrics.jsonl (log_every_n_steps=1)."""
+    out = {}
+    with open(os.path.join(root, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "train/loss" in rec:
+                out[rec["step"]] = rec["train/loss"]
+    return out
+
+
+def test_sigkill_mid_stream_resume_is_step_identical(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+
+    straight = _run_worker(str(script), "straight", tmp_path / "straight")
+    assert straight.returncode == 0, straight.stderr[-2000:]
+    assert "DONE step=8" in straight.stdout
+
+    killed = _run_worker(str(script), "kill", tmp_path / "killed")
+    # the process must have died BY the kill signal — not exited cleanly
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stdout, killed.stderr[-2000:]
+    )
+    killed_losses = _losses(tmp_path / "killed")
+    assert sorted(killed_losses) == [1, 2, 3, 4]  # died fetching step 5
+    # snapshots at steps 2 and 4 survived the kill (synchronous orbax saves)
+    snap_steps = sorted(
+        int(d.name) for d in (tmp_path / "killed" / "resume").iterdir()
+        if d.name.isdigit()
+    )
+    assert snap_steps[-1] == 4
+
+    resumed = _run_worker(
+        str(script), "resume", tmp_path / "resumed", resume_from=tmp_path / "killed"
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    assert "DONE step=8" in resumed.stdout
+    resumed_losses = _losses(tmp_path / "resumed")
+    assert sorted(resumed_losses) == [5, 6, 7, 8]  # picked up after snapshot 4
+
+    # the acceptance bar: killed-prefix + resumed-suffix is STEP-IDENTICAL
+    # to the uninterrupted trajectory
+    straight_losses = _losses(tmp_path / "straight")
+    stitched = {**killed_losses, **resumed_losses}
+    assert stitched == straight_losses

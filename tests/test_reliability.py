@@ -1,0 +1,478 @@
+"""Reliability-layer chaos suite (docs/reliability.md): every fault is
+injected deterministically through the explicit-hook registry
+(``reliability.chaos``) — no sleeps, no monkeypatched timing, no
+randomness — so these tests reproduce bit-identically on CPU.
+
+Covered drills: serving backpressure (``QueueFull`` + shed counter),
+deadline expiry on a fake clock, hung/failed request isolation, executor
+failure isolation, graceful drain + health; trainer ``non_finite_policy``
+skip/rollback recovery and rank-0 callback isolation; data-source retry
+with exponential backoff (streaming + map-style).
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from perceiver_io_tpu.inference.generate import GenerationConfig
+from perceiver_io_tpu.inference.samplers import SamplingConfig
+from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.parallel import MeshConfig, make_mesh
+from perceiver_io_tpu.reliability import (
+    ChaosRegistry,
+    FakeClock,
+    InjectedFault,
+    QueueFull,
+    RetryPolicy,
+    call_with_retry,
+    resilient_source,
+)
+from perceiver_io_tpu.serving import BucketTable, ServingEngine
+from perceiver_io_tpu.training.tasks import clm_loss_fn
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+# every test here must finish long before this; a wedged scheduler loop
+# fails the test, not the suite
+pytestmark = [pytest.mark.chaos, pytest.mark.timeout(240)]
+
+KEY = jax.random.PRNGKey(0)
+
+# Deliberately NOT a shape other test modules use (vocab 61): executor cache
+# keys include the module fingerprint, and an identically configured model
+# elsewhere would pre-populate the caches this file's engines count.
+TINY = dict(
+    vocab_size=61, max_seq_len=16, max_latents=8, num_channels=8,
+    num_heads=1, num_self_attention_layers=1, cross_attention_dropout=0.0,
+)
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = CausalLanguageModelConfig(**TINY)
+    model = CausalLanguageModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 16), jnp.int32), 8)["params"]
+    return model, params
+
+
+def _engine(tiny_model, **kwargs):
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=2, num_latents=2, sampling=GREEDY)
+    table = BucketTable(prompt_lens=(8,), batch_sizes=(2,))
+    return ServingEngine(model, params, cfg, table, **kwargs)
+
+
+def _prompts(n, length=4, vocab=61):
+    rng = np.random.default_rng(0)
+    return [rng.integers(1, vocab, size=length).astype(np.int32) for _ in range(n)]
+
+
+# -- serving: backpressure --------------------------------------------------
+def test_queue_full_backpressure_sheds_and_counts(tiny_model):
+    engine = _engine(tiny_model, max_queue=2)
+    a, b = [engine.submit(p) for p in _prompts(2)]
+    with pytest.raises(QueueFull, match="max_queue=2"):
+        engine.submit(_prompts(1)[0])
+    assert engine.stats()["shed"] == 1
+    assert not engine.health()["ready"]  # at capacity: not ready for more
+    engine.step()  # drain one micro-batch -> capacity frees up
+    c = engine.submit(_prompts(1)[0])
+    engine.run_until_idle()
+    assert [r.status for r in (a, b, c)] == ["ok", "ok", "ok"]
+    stats = engine.stats()
+    assert stats["completed"] == 3 and stats["shed"] == 1 and stats["queued"] == 0
+
+
+# -- serving: deadlines -----------------------------------------------------
+def test_expired_requests_time_out_instead_of_occupying_slots(tiny_model):
+    clock = FakeClock()
+    engine = _engine(tiny_model, clock=clock)
+    stale = engine.submit(_prompts(1)[0], deadline_s=1.0)
+    fresh = engine.submit(_prompts(1)[0], deadline_s=100.0)
+    clock.advance(5.0)  # past stale's deadline, inside fresh's
+    engine.run_until_idle()
+    assert stale.status == "timed_out" and stale.result is None
+    assert "deadline exceeded" in stale.error
+    assert fresh.status == "ok" and fresh.result is not None
+    stats = engine.stats()
+    assert stats["timed_out"] == 1 and stats["completed"] == 1
+
+
+def test_hung_request_times_out_while_others_complete(tiny_model):
+    chaos = ChaosRegistry()
+    chaos.hang_request(1, delay_s=2.0)  # request_id 1 stalls 2s on the clock
+    engine = _engine(tiny_model, clock=FakeClock(), chaos=chaos)
+    reqs = [
+        engine.submit(p, deadline_s=1.0 if i == 1 else 60.0)
+        for i, p in enumerate(_prompts(4))
+    ]
+    engine.run_until_idle()
+    assert reqs[1].status == "timed_out" and "hung" in reqs[1].error
+    assert [reqs[i].status for i in (0, 2, 3)] == ["ok"] * 3
+    assert engine.stats()["timed_out"] == 1 and engine.stats()["completed"] == 3
+
+
+# -- serving: error isolation ----------------------------------------------
+def test_failed_request_is_isolated_from_its_micro_batch(tiny_model):
+    chaos = ChaosRegistry()
+    chaos.fail_request(1, message="synthetic per-request fault")
+    engine = _engine(tiny_model, chaos=chaos)
+    reqs = [engine.submit(p) for p in _prompts(4)]
+    engine.run_until_idle()
+    assert reqs[1].status == "failed"
+    assert "synthetic per-request fault" in reqs[1].error
+    assert [reqs[i].status for i in (0, 2, 3)] == ["ok"] * 3
+    assert all(reqs[i].result is not None for i in (0, 2, 3))
+    assert engine.stats()["failed"] == 1 and engine.stats()["completed"] == 3
+
+
+def test_executor_failure_fails_batch_but_queue_survives(tiny_model):
+    chaos = ChaosRegistry()
+    chaos.fail_batch(1)  # first micro-batch dispatch blows up
+    engine = _engine(tiny_model, chaos=chaos)
+    reqs = [engine.submit(p) for p in _prompts(4)]  # 2 micro-batches of 2
+    engine.run_until_idle()
+    assert [r.status for r in reqs[:2]] == ["failed", "failed"]
+    assert all("injected" in r.error for r in reqs[:2])
+    assert [r.status for r in reqs[2:]] == ["ok", "ok"]
+    stats = engine.stats()
+    assert stats["failed"] == 2 and stats["completed"] == 2 and stats["queued"] == 0
+
+
+# -- serving: drain + health ------------------------------------------------
+def test_drain_completes_queue_and_rejects_new_submissions(tiny_model):
+    engine = _engine(tiny_model)
+    reqs = [engine.submit(p) for p in _prompts(3)]
+    disposed = engine.drain()
+    assert disposed == 3 and all(r.status == "ok" for r in reqs)
+    with pytest.raises(RuntimeError, match="draining"):
+        engine.submit(_prompts(1)[0])
+    health = engine.health()
+    assert health["accepting"] is False and health["ready"] is False
+    assert health["queue_depth"] == 0 and health["completed"] == 3
+
+
+def test_health_snapshot_tracks_queue_depth_and_oldest_wait(tiny_model):
+    clock = FakeClock()
+    engine = _engine(tiny_model, clock=clock, max_queue=8)
+    assert engine.health()["ready"] and engine.health()["oldest_wait_ms"] == 0.0
+    engine.submit(_prompts(1)[0])
+    clock.advance(0.25)
+    engine.submit(_prompts(1)[0])
+    health = engine.health()
+    assert health["queue_depth"] == 2
+    assert health["oldest_wait_ms"] == pytest.approx(250.0)
+    engine.run_until_idle()
+    assert engine.health()["queue_depth"] == 0
+
+
+def test_submit_rejects_overlong_and_empty_prompts(tiny_model):
+    engine = _engine(tiny_model)  # largest bucket: 8
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        engine.submit(np.arange(1, 10, dtype=np.int32))
+    with pytest.raises(ValueError, match="empty prompt"):
+        engine.submit(np.zeros((0,), np.int32))
+    assert engine.stats()["requests"] == 0  # nothing was enqueued
+
+
+# -- trainer: divergence policies ------------------------------------------
+VOCAB, SEQ, LATENTS = 32, 16, 8
+
+
+def _tr_model():
+    cfg = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=SEQ, max_latents=LATENTS, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.5,
+    )
+    return CausalLanguageModel(config=cfg), cfg
+
+
+def _tr_batches(n):
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, VOCAB, (4, SEQ + 1), dtype=np.int64)
+        out.append({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+    return out
+
+
+def _tr_fit(root, max_steps, *, chaos=None, tx=None, callbacks=(), **cfg_kwargs):
+    model, cfg = _tr_model()
+    mesh = make_mesh(MeshConfig(data=1))
+    defaults = dict(
+        max_steps=max_steps, val_check_interval=10_000,
+        log_every_n_steps=10_000, default_root_dir=str(root),
+        enable_checkpointing=False, enable_tensorboard=False, seed=7,
+    )
+    defaults.update(cfg_kwargs)
+    trainer = Trainer(
+        TrainerConfig(**defaults),
+        mesh,
+        clm_loss_fn(model, LATENTS),
+        tx if tx is not None else optax.adamw(1e-3),
+        model_config=cfg,
+        callbacks=callbacks,
+        chaos=chaos,
+    )
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS,
+        )["params"]
+
+    state = trainer.fit(init_params, _tr_batches(6))
+    trainer.close()
+    return state, trainer
+
+
+def _all_finite(params) -> bool:
+    return all(
+        np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(params)
+    )
+
+
+def test_skip_policy_discards_bad_step_and_finishes(tmp_path):
+    """Acceptance drill: injected NaN at step 3 with non_finite_policy=skip
+    finishes training with finite params and skipped_steps == 1."""
+    chaos = ChaosRegistry()
+    chaos.nan_loss_at_step(3)
+    state, trainer = _tr_fit(
+        tmp_path, 6, chaos=chaos, non_finite_policy="skip"
+    )
+    assert trainer.fault_stats["skipped_steps"] == 1
+    assert trainer.fault_stats["rollbacks"] == 0
+    assert int(state.step) == 5  # 6 steps walked, 1 update discarded
+    assert _all_finite(state.params)
+    assert chaos.fired_count("trainer.step") == 1
+    lines = [json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert any("non_finite_skipped" in l for l in lines)
+
+
+def test_rollback_policy_restores_snapshot_and_replays(tmp_path):
+    """Acceptance drill: after K=2 consecutive injected-NaN steps the trainer
+    restores the latest finite snapshot, rewinds the data stream, and the
+    replayed run lands on the SAME final state as an undisturbed run (per-step
+    fold_in rng + replay-buffer rewind make the trajectory identical)."""
+    straight, _ = _tr_fit(tmp_path / "straight", 8)
+
+    chaos = ChaosRegistry()
+    chaos.nan_loss_at_step(4, count=2)  # executed steps 4 and 5 report NaN
+    state, trainer = _tr_fit(
+        tmp_path / "faulted", 8, chaos=chaos,
+        non_finite_policy="rollback", non_finite_rollback_after=2,
+        save_state_every_n_steps=2,
+    )
+    assert trainer.fault_stats["rollbacks"] == 1
+    assert trainer.fault_stats["skipped_steps"] == 1  # step 4, before the trigger
+    assert int(state.step) == int(straight.step) == 8
+    assert _all_finite(state.params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.params),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+
+
+def test_rollback_requires_snapshot_cadence(tmp_path):
+    with pytest.raises(ValueError, match="save_state_every_n_steps"):
+        _tr_fit(tmp_path, 4, non_finite_policy="rollback")
+
+
+def test_rollback_rejects_stale_snapshots_from_previous_run(tmp_path):
+    """A fresh rollback fit into a root whose resume/ dir holds a previous
+    run's snapshots must fail with an actionable error at fit start — a
+    mid-run rollback would otherwise restore a foreign trajectory."""
+    _tr_fit(tmp_path, 4, save_state_every_n_steps=2)  # leaves snapshots 2, 4
+    with pytest.raises(ValueError, match="previous run"):
+        _tr_fit(
+            tmp_path, 6,
+            non_finite_policy="rollback", save_state_every_n_steps=2,
+        )
+
+
+@pytest.mark.slow
+def test_skip_policy_halts_on_persistent_streak(tmp_path):
+    """K consecutive non-finite steps under skip is persistent divergence:
+    the trainer raises instead of silently completing the run on a
+    last-good state that may itself hide an earlier overflow."""
+    chaos = ChaosRegistry()
+    chaos.nan_loss_at_step(2, count=10)
+    with pytest.raises(FloatingPointError, match="consecutive"):
+        _tr_fit(
+            tmp_path, 8, chaos=chaos,
+            non_finite_policy="skip", non_finite_rollback_after=3,
+        )
+
+
+@pytest.mark.slow
+def test_persistent_divergence_exhausts_rollbacks_and_halts(tmp_path):
+    """A REAL (not injected) persistent blow-up under rollback: every replay
+    diverges again, so after non_finite_max_rollbacks the trainer raises
+    instead of looping forever."""
+    with pytest.raises(FloatingPointError, match="rollbacks"):
+        _tr_fit(
+            tmp_path, 12, tx=optax.sgd(1e38),
+            non_finite_policy="rollback", non_finite_rollback_after=2,
+            non_finite_max_rollbacks=2, save_state_every_n_steps=3,
+        )
+
+
+def test_invalid_policy_rejected(tmp_path):
+    model, cfg = _tr_model()
+    with pytest.raises(ValueError, match="non_finite_policy"):
+        Trainer(
+            TrainerConfig(
+                max_steps=1, default_root_dir=str(tmp_path),
+                enable_checkpointing=False, enable_tensorboard=False,
+                non_finite_policy="retry",
+            ),
+            make_mesh(MeshConfig(data=1)),
+            clm_loss_fn(model, LATENTS),
+            optax.adamw(1e-3),
+        )
+
+
+# -- trainer: callback isolation + deterministic log teardown ---------------
+@pytest.mark.slow
+def test_failing_validation_callback_logged_not_fatal(tmp_path, capsys):
+    calls = []
+
+    def bad_callback(trainer, state, step, val_metrics):
+        calls.append(step)
+        raise RuntimeError("qualitative sampling exploded")
+
+    model, cfg = _tr_model()
+    mesh = make_mesh(MeshConfig(data=1))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=4, val_check_interval=2, log_every_n_steps=10_000,
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+            enable_tensorboard=False, seed=7,
+        ),
+        mesh, clm_loss_fn(model, LATENTS), optax.adamw(1e-3),
+        model_config=cfg, callbacks=[bad_callback],
+    )
+
+    def init_params():
+        return model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((1, SEQ), jnp.int32), SEQ - LATENTS,
+        )["params"]
+
+    state = trainer.fit(
+        init_params, _tr_batches(4), val_data=lambda: _tr_batches(1)
+    )
+    assert int(state.step) == 4  # the run survived both callback explosions
+    assert calls == [2, 4]
+    assert trainer.fault_stats["callback_errors"] == 2
+    assert "qualitative sampling exploded" in capsys.readouterr().err
+    # deterministic teardown: fit closed the writers on its way out, and the
+    # log is complete, valid JSONL
+    assert trainer._metrics_file is None
+    lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    assert lines and all(json.loads(l) for l in lines)
+    assert any("callback_errors" in json.loads(l) for l in lines)
+    trainer.close()
+
+
+# -- data: retry with exponential backoff ----------------------------------
+def test_resilient_source_survives_transient_fault():
+    chaos = ChaosRegistry()
+    chaos.loader_error_on_record(4)  # 4th pull raises, exactly once
+    sleeps = []
+    policy = RetryPolicy(max_retries=2, backoff_base_s=0.5, backoff_factor=2.0)
+    out = list(resilient_source(
+        chaos.wrap_source(lambda: iter("abcdefgh")), policy, sleep=sleeps.append
+    ))
+    assert out == list("abcdefgh")  # duplicate-free, gap-free
+    assert sleeps == [0.5]  # one retry, first backoff step
+    assert chaos.fired_count("data.record") == 1
+
+
+def test_resilient_source_exhausts_retries_and_raises():
+    chaos = ChaosRegistry()
+    chaos.loader_error_on_record(3, count=50)  # persistent fault
+    sleeps = []
+    policy = RetryPolicy(max_retries=2, backoff_base_s=1.0, backoff_factor=3.0)
+    with pytest.raises(InjectedFault):
+        list(resilient_source(
+            chaos.wrap_source(lambda: iter("abcdef")), policy, sleep=sleeps.append
+        ))
+    assert sleeps == [1.0, 3.0]  # exponential schedule, then give up
+
+
+def test_call_with_retry_backoff_schedule():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    sleeps = []
+    policy = RetryPolicy(max_retries=5, backoff_base_s=0.25, backoff_max_s=0.4)
+    assert call_with_retry(flaky, policy, sleep=sleeps.append) == "ok"
+    assert sleeps == [0.25, 0.4]  # second delay clamped by backoff_max_s
+
+
+def test_streaming_pipeline_survives_source_fault():
+    from perceiver_io_tpu.data.text.streaming import StreamingTextPipeline
+    from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
+
+    texts = [f"record number {i} padding it out a bit" for i in range(12)]
+    kwargs = dict(
+        tokenizer=ByteTokenizer(), max_seq_len=16, batch_size=2,
+        shard_index=0, shard_count=1,
+    )
+    plain = list(StreamingTextPipeline(lambda: iter(texts), **kwargs))
+
+    chaos = ChaosRegistry()
+    chaos.loader_error_on_record(5)
+    sleeps = []
+    faulted = list(StreamingTextPipeline(
+        chaos.wrap_source(lambda: iter(texts)),
+        retry_policy=RetryPolicy(max_retries=2),
+        retry_sleep=sleeps.append,
+        **kwargs,
+    ))
+    assert chaos.fired_count() == 1 and len(sleeps) == 1
+    assert len(faulted) == len(plain)
+    for a, b in zip(plain, faulted):
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+
+
+def test_dataloader_retries_flaky_getitem():
+    from perceiver_io_tpu.data.loader import DataLoader
+
+    class FlakyDataset:
+        def __init__(self):
+            self.failed = False
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 3 and not self.failed:
+                self.failed = True
+                raise OSError("transient storage fault")
+            return {"x": np.asarray([i])}
+
+    sleeps = []
+    loader = DataLoader(
+        FlakyDataset(), batch_size=2, shard_index=0, shard_count=1,
+        prefetch=0, retry_policy=RetryPolicy(max_retries=2),
+        retry_sleep=sleeps.append,
+    )
+    batches = list(loader)
+    assert len(batches) == 4 and len(sleeps) == 1
+    assert sorted(int(b["x"][i, 0]) for b in batches for i in range(2)) == list(range(8))
+
+    with pytest.raises(OSError):  # fail-fast default is unchanged
+        list(DataLoader(FlakyDataset(), batch_size=2, shard_index=0,
+                        shard_count=1, prefetch=0))

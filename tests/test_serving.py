@@ -24,6 +24,10 @@ from perceiver_io_tpu.inference.samplers import SamplingConfig
 from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
 from perceiver_io_tpu.serving import BucketTable, ServingEngine
 
+# Per-test deadline guard (tests/conftest.py): a scheduler regression that
+# wedges the queue loop fails THAT test instead of eating the suite budget.
+pytestmark = pytest.mark.timeout(300)
+
 KEY = jax.random.PRNGKey(0)
 
 # Deliberately NOT the shape other test modules use (vocab 67): executor
@@ -315,6 +319,38 @@ def test_serve_cli_requires_ckpt():
         clm_script.main(["serve", "--serve.max_new_tokens=2"])
 
 
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_serve_cli_maps_infeasible_prompt_to_error_record(tmp_path):
+    """A prompt longer than the largest bucket becomes a per-line
+    ``{"error": ...}`` JSON record; the rest of the run still completes."""
+    from perceiver_io_tpu.scripts.text import clm as clm_script
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=262, max_seq_len=32, max_latents=16, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 16)["params"]
+    save_pretrained(str(tmp_path / "ckpt"), params, cfg)
+    (tmp_path / "prompts.txt").write_text(
+        "hi\n" + "x" * 50 + "\nok\n"  # line 2 exceeds the 8-token bucket
+    )
+
+    results = clm_script.main([
+        "serve", "--ckpt", str(tmp_path / "ckpt"),
+        f"--serve.prompts={tmp_path}/prompts.txt",
+        "--serve.max_new_tokens=2", "--serve.num_latents=2",
+        "--serve.prompt_buckets=8", "--serve.batch_buckets=2",
+        "--serve.warmup=false",
+    ])
+    assert [r["prompt"] for r in results] == ["hi", "x" * 50, "ok"]
+    assert "completion" in results[0] and "completion" in results[2]
+    assert results[1]["status"] == "rejected"
+    assert "exceeds the largest bucket" in results[1]["error"]
+
+
 # -- bench probe -----------------------------------------------------------
 def test_bench_serve_probe_tiny(tiny_model):
     """The bench.py serving probe must emit tokens/s + compile_count on a
@@ -335,3 +371,25 @@ def test_bench_serve_probe_tiny(tiny_model):
     assert out["requests"] == 6 and out["new_tokens"] == 2
     assert out["p95_queue_wait_ms"] >= out["p50_queue_wait_ms"] >= 0.0
     assert out["distinct_prompt_lens"] >= 1
+
+
+@pytest.mark.chaos
+def test_bench_chaos_probe_tiny(tiny_model):
+    """The bench.py chaos probe (``extras.chaos``) is deterministic on CPU:
+    fixed shed/timeout/failure counts, engine accounting closed."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(root, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    model, params = tiny_model
+    out = bench._bench_chaos(model, params, model.config)
+    assert out["survived"] is True
+    assert out["submitted"] == 8
+    assert out["shed"] == 2  # max_queue = 6
+    assert out["timed_out"] == 1 and out["failed"] == 1
+    assert out["completed"] == 4
+    assert out["ready_after_drain"] is False  # drained engines stop accepting
