@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos cov bench dryrun lint
+.PHONY: test test-fast chaos obs cov bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -13,6 +13,11 @@ test-fast:
 # also included in the tier-1 "not slow" run
 chaos:
 	$(PY) -m pytest tests/ -q -m chaos --continue-on-collection-errors
+
+# unified telemetry layer suite (docs/observability.md) — CPU-fast,
+# also included in the tier-1 "not slow" run
+obs:
+	$(PY) -m pytest tests/ -q -m observability --continue-on-collection-errors
 
 cov:
 	$(PY) -m pytest tests/ -q --cov=perceiver_io_tpu --cov-report=term-missing

@@ -1,10 +1,13 @@
 """Benchmark: Perceiver AR 8k-context training throughput on one chip, plus
-the Perceiver IO MLM training config, cached-decode throughput, and a
+the Perceiver IO MLM training config, cached-decode throughput, a
 mixed-length bucketed-serving probe (``extras.serve``: tokens/s,
-compile_count, p50/p95 queue wait — the serving-layer trajectory).
+compile_count, p50/p95 queue wait — the serving-layer trajectory), and an
+instrumented telemetry probe (``extras.observability``: per-phase latency
+histograms, goodput, MFU gauges; docs/observability.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
-secondary metrics under "extras".
+secondary metrics under "extras"; the record also carries the process-wide
+registry snapshot (``metrics_snapshot``) so BENCH_* files ship telemetry.
 
 The reference publishes no throughput numbers (BASELINE.md), so the baseline
 is the north star from BASELINE.json: **0.8× an A100 on the same step**. The
@@ -524,6 +527,29 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "chaos": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: observability probe (telemetry layer end to end) ----
+        if left() > 60.0:
+            log("run: observability probe (histograms / goodput / MFU gauges)")
+            try:
+                obs = _bench_observability(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "observability": obs})
+                log(f"run: observability goodput={obs['goodput']} "
+                    f"mfu={obs['mfu']} span_accounting_closed="
+                    f"{obs['span_accounting_closed']}")
+            except Exception as e:
+                log(f"run: observability probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "observability": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
+        # BENCH_* records carry the process-wide telemetry snapshot from now
+        # on (executor-cache counters etc.; docs/observability.md).
+        try:
+            from perceiver_io_tpu.observability import default_registry
+
+            res.update(metrics_snapshot=default_registry().snapshot())
+        except Exception as e:
+            log(f"run: metrics snapshot skipped ({type(e).__name__}: {e})")
+
     log(f"run: wrote {out_path}")
 
 
@@ -769,6 +795,98 @@ def _bench_chaos(model, params, cfg, *, n_requests: int = 8, new_tokens: int = 4
         "survived": accounted == n_requests and s["queued"] == 0,
         "ready_after_drain": engine.health()["ready"],
         "wall_s": round(wall_s, 3),
+    }
+
+
+def _bench_observability(model, params, cfg, *, n_requests: int = 12,
+                         new_tokens: int = 4):
+    """Unified-telemetry probe (docs/observability.md): mixed-length traffic
+    through a registry+tracer-instrumented ``ServingEngine``, with one
+    deterministic pack-time fault so goodput < 1 is exercised, not assumed.
+    Reports the three per-phase latency histograms (queue wait, batch
+    assembly, device execute), serving throughput, goodput
+    (completed / submitted), and an MFU gauge — decode FLOPs/token from
+    ``utils/flops.flops_approx`` (fwd-only ≈ 2N) against the detected device
+    peak (None on the CPU fallback, where no peak is claimable). Also
+    asserts span accounting closes: every submission ends in exactly one
+    terminal ``serving.request`` span."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.observability import MetricsRegistry, Tracer
+    from perceiver_io_tpu.reliability.chaos import ChaosRegistry
+    from perceiver_io_tpu.serving import BucketTable, ServingEngine
+    from perceiver_io_tpu.utils.flops import flops_approx
+
+    params = cast_float_params(params, jnp.bfloat16)
+    num_latents = min(16, cfg.max_latents)
+    max_prefix = cfg.max_seq_len - cfg.max_latents
+    max_len = min(128, cfg.max_seq_len // 2, max_prefix + num_latents)
+    lens_grid = sorted({max(num_latents, max_len // 2), max_len})
+    table = BucketTable(prompt_lens=tuple(lens_grid), batch_sizes=(2, 4))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+
+    chaos = ChaosRegistry()
+    chaos.fail_request(2)  # deterministic non-ok terminal state
+    registry = MetricsRegistry()
+    tracer = Tracer()
+    engine = ServingEngine(
+        model, params, gcfg, table, chaos=chaos,
+        registry=registry, tracer=tracer,
+    )
+
+    rng = np.random.default_rng(0)
+    lo = max(1, max_len // 4)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=int(n), dtype=np.int32)
+        for n in rng.integers(lo, max_len + 1, size=n_requests)
+    ]
+    t0 = time.perf_counter()
+    for p in prompts:
+        engine.submit(p)
+    engine.drain()
+    wall = time.perf_counter() - t0
+
+    s = engine.stats()
+    terminal: dict = {}
+    for sp in tracer.spans("serving.request"):
+        terminal[sp.status] = terminal.get(sp.status, 0) + 1
+    # goodput denominator is OFFERED load (accepted + shed + rejected), per
+    # the "completed vs shed+timed_out+failed" definition — an engine that
+    # sheds half its traffic must not report goodput 1.0
+    offered = s["requests"] + s["shed"] + s["rejected"]
+    goodput = s["completed"] / max(1, offered)
+    tokens_per_sec = s["tokens_generated"] / wall
+
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+    )
+    decode_flops_per_token = flops_approx(n_params) // 3  # fwd-only ≈ 2N
+    peak = peak_flops(jax.devices()[0])
+    mfu = (
+        round(tokens_per_sec * decode_flops_per_token / peak, 6) if peak else None
+    )
+    registry.set_gauge("serving_throughput_tokens_per_sec", tokens_per_sec)
+    registry.set_gauge("serving_goodput_ratio", goodput)
+    if mfu is not None:
+        registry.set_gauge("serving_mfu", mfu)
+    snap = registry.snapshot()
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "goodput": round(goodput, 4),
+        "mfu": mfu,
+        "queue_wait_ms": snap["histograms"].get("serving_queue_wait_ms"),
+        "batch_assembly_ms": snap["histograms"].get("serving_batch_assembly_ms"),
+        "device_execute_ms": snap["histograms"].get("serving_device_execute_ms"),
+        "request_latency_ms": snap["histograms"].get("serving_request_latency_ms"),
+        "terminal_spans": terminal,
+        "span_accounting_closed": sum(terminal.values()) == n_requests,
+        "requests": n_requests,
+        "new_tokens": new_tokens,
+        "snapshot": snap,
     }
 
 

@@ -478,13 +478,32 @@ def model_fingerprint(model) -> str:
 #: Process-wide hit/miss/evict counters across ALL executor caches (the
 #: generation cache here and the beam cache in ``beam.py``). A miss means a
 #: fresh trace+compile (~1.5 s at test scale) — the serving layer reads these
-#: so retracing under real traffic is observable rather than silent.
-_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+#: so retracing under real traffic is observable rather than silent. The
+#: counters live on the process-wide observability registry under the
+#: canonical ``executor_cache_*_total`` names (docs/observability.md); the
+#: bare "hits"/"misses"/"evictions" keys remain as deprecation aliases.
+_CACHE_COUNTERS = {
+    "hits": "executor_cache_hits_total",
+    "misses": "executor_cache_misses_total",
+    "evictions": "executor_cache_evictions_total",
+}
 
 
 def executor_cache_stats() -> dict:
-    """Snapshot of the shared executor-cache counters."""
-    return dict(_CACHE_STATS)
+    """Snapshot of the shared executor-cache counters, under both the
+    canonical registry names (``executor_cache_hits_total``, ...) and the
+    legacy short keys (``hits``, ...) — prefer the canonical ones; the
+    aliases exist for the serve CLI / bench probes written before the
+    unified telemetry layer."""
+    from perceiver_io_tpu.observability import default_registry
+
+    reg = default_registry()
+    out = {}
+    for alias, name in _CACHE_COUNTERS.items():
+        value = int(reg.counter(name))
+        out[alias] = value
+        out[name] = value
+    return out
 
 
 def reset_executor_caches() -> None:
@@ -494,25 +513,28 @@ def reset_executor_caches() -> None:
     their ``stats()`` deltas clamp at 0 rather than going negative, but
     create engines after the reset when exact counts matter."""
     from perceiver_io_tpu.inference import beam
+    from perceiver_io_tpu.observability import default_registry
 
     _EXECUTOR_CACHE.clear()
     beam._EXECUTOR_CACHE.clear()
-    for k in _CACHE_STATS:
-        _CACHE_STATS[k] = 0
+    default_registry().reset("executor_cache_")
 
 
 def cached_executor(cache: dict, key, build, *, max_entries: int = 64):
     """FIFO-bounded compile-once cache shared by the generation and beam
     executors: ``build()`` is called (and jitted) only on a key miss."""
+    from perceiver_io_tpu.observability import default_registry
+
+    reg = default_registry()
     cached = cache.get(key)
     if cached is not None:
-        _CACHE_STATS["hits"] += 1
+        reg.inc("executor_cache_hits_total")
         return cached
-    _CACHE_STATS["misses"] += 1
+    reg.inc("executor_cache_misses_total")
     executor = build()
     if len(cache) >= max_entries:
         cache.pop(next(iter(cache)))
-        _CACHE_STATS["evictions"] += 1
+        reg.inc("executor_cache_evictions_total")
     cache[key] = executor
     return executor
 

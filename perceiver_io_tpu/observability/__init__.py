@@ -1,0 +1,90 @@
+"""Unified telemetry layer (docs/observability.md).
+
+Before this package the repo had four telemetry islands — trainer
+``metrics.jsonl``/TensorBoard, ``ServingEngine.stats()``,
+``inference.executor_cache_stats``, and ``Trainer.fault_stats`` — with no
+shared names, no latency attribution, and no export path. Serving-on-TPU
+and pjit-scale-training practice (PAPERS.md: the Gemma-on-TPU serving
+comparison; the pjit/TPUv4 scalable-training paper) both treat per-phase
+latency histograms and goodput/MFU telemetry as prerequisites for perf
+work; this is that instrumentation spine:
+
+- :class:`MetricsRegistry` — thread-safe counters / gauges /
+  bounded-reservoir histograms (p50/p95/p99/max) with an injectable clock
+  (composes with :class:`~perceiver_io_tpu.reliability.FakeClock`).
+- :class:`Tracer` / :class:`Span` — per-request trace IDs through the
+  ServingEngine lifecycle and per-step spans through the Trainer loop,
+  streamed to a rank-0 ``events.jsonl`` (:class:`JsonlSpanSink`).
+- :func:`to_prometheus_text` / :func:`snapshot_json` /
+  :class:`SnapshotWriter` — one registry, two export formats.
+- :class:`ProfilerTrigger` — arms a ``jax.profiler`` capture of the next
+  step when the step-time p95 regresses.
+- :mod:`~perceiver_io_tpu.observability.compat` — the metrics.jsonl
+  schema-migration reader.
+
+Everything here is stdlib-only (no jax import at module scope), so the
+inference/serving/training layers can depend on it without cycles and the
+hot-path cost is dict ops under one lock.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from perceiver_io_tpu.observability.compat import normalize_row, read_metrics_jsonl
+from perceiver_io_tpu.observability.exporters import (
+    SnapshotWriter,
+    snapshot_json,
+    to_prometheus_text,
+)
+from perceiver_io_tpu.observability.registry import (
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from perceiver_io_tpu.observability.tracing import (
+    JsonlSpanSink,
+    Span,
+    Tracer,
+    read_events_jsonl,
+)
+from perceiver_io_tpu.observability.trigger import ProfilerTrigger
+
+
+@dataclasses.dataclass
+class ObservabilityArgs:
+    """The CLI's ``--obs.*`` flag group, shared by ``fit`` and ``serve``.
+
+    All fields default to off: telemetry costs nothing unless asked for,
+    matching the ``chaos=None`` / ``tracer=None`` convention.
+    """
+
+    #: span events JSONL path (rank-0). For ``fit``, relative paths land
+    #: under ``--trainer.default_root_dir``.
+    events_path: Optional[str] = None
+    #: write a registry snapshot JSON at most every N seconds (the trainer
+    #: checks at each log flush; the serve CLI per drain pass)
+    snapshot_every_s: Optional[float] = None
+    #: snapshot destination; defaults next to the events/metrics files
+    snapshot_path: Optional[str] = None
+    #: arm a jax.profiler capture of the next step when the step-time p95
+    #: exceeds this factor × the warmed-up baseline p95 (None disables)
+    profile_on_regress_factor: Optional[float] = None
+
+
+__all__ = [
+    "Histogram",
+    "JsonlSpanSink",
+    "MetricsRegistry",
+    "ObservabilityArgs",
+    "ProfilerTrigger",
+    "SnapshotWriter",
+    "Span",
+    "Tracer",
+    "default_registry",
+    "normalize_row",
+    "read_events_jsonl",
+    "read_metrics_jsonl",
+    "snapshot_json",
+    "to_prometheus_text",
+]

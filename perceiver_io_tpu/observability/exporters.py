@@ -1,0 +1,125 @@
+"""Exporters: Prometheus text format and JSON snapshots of a
+:class:`~perceiver_io_tpu.observability.MetricsRegistry`.
+
+Two formats, one source:
+
+- :func:`to_prometheus_text` — the ``text/plain; version=0.0.4`` exposition
+  format a scrape endpoint (or a human with ``curl``) reads. Histograms
+  render as Prometheus *summaries* (quantile series + ``_sum``/``_count``):
+  we keep raw reservoirs, not fixed buckets, so quantiles are the honest
+  export.
+- :func:`snapshot_json` / :class:`SnapshotWriter` — the machine-readable
+  snapshot the serve CLI appends to ``serve_stats``, the trainer drops next
+  to ``metrics.jsonl``, and ``bench.py`` embeds in its record so every
+  BENCH_* file carries telemetry.
+
+``SnapshotWriter`` is cadence-gated on an injectable clock
+(``--obs.snapshot_every_s``): callers invoke :meth:`SnapshotWriter.maybe_write`
+opportunistically from their own loop (the trainer at each log flush, the
+serve CLI per drain pass) and the writer decides whether enough time has
+passed — no background thread to leak.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from perceiver_io_tpu.observability.registry import MetricsRegistry
+
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+    return out if out and not out[0].isdigit() else f"_{out}"
+
+
+def _num(value: float) -> str:
+    """Full-precision numeric rendering: '%g' would quantize counters past
+    1e6 (12,345,678 -> 1.23457e+07), corrupting scraped rate()/delta math.
+    Integral values render bare; others use the shortest round-trip repr."""
+    value = float(value)
+    if value.is_integer():
+        return str(int(value))
+    return repr(value)
+
+
+def to_prometheus_text(registry: MetricsRegistry) -> str:
+    """Render the registry in Prometheus exposition format (counters,
+    gauges, histogram summaries), sorted by name for stable diffs."""
+    snap = registry.snapshot()
+    lines = []
+    for name, value in sorted(snap["counters"].items()):
+        metric = _sanitize(name)
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_num(value)}")
+    for name, value in sorted(snap["gauges"].items()):
+        metric = _sanitize(name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_num(value)}")
+    for name, summ in sorted(snap["histograms"].items()):
+        metric = _sanitize(name)
+        lines.append(f"# TYPE {metric} summary")
+        for q, key in _QUANTILES:
+            if summ[key] is not None:
+                lines.append(f'{metric}{{quantile="{q}"}} {_num(summ[key])}')
+        lines.append(f"{metric}_sum {_num(summ['sum'])}")
+        lines.append(f"{metric}_count {_num(summ['count'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot_json(registry: MetricsRegistry, *, indent: Optional[int] = None) -> str:
+    return json.dumps(registry.snapshot(), indent=indent, sort_keys=True)
+
+
+class SnapshotWriter:
+    """Periodically dump a registry snapshot to one JSON file, atomically
+    (tmp + rename: a reader never sees a torn file).
+
+    :param every_s: minimum seconds between writes; None = only explicit
+        ``maybe_write(force=True)`` calls write.
+    :param clock: injectable time source (FakeClock in tests).
+    """
+
+    def __init__(self, registry: MetricsRegistry, path: str,
+                 *, every_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self.path = path
+        self.every_s = every_s
+        self._clock = clock
+        self._last_write: Optional[float] = None
+        self.writes = 0
+        self.write_errors = 0
+
+    def maybe_write(self, *, force: bool = False) -> bool:
+        """Write if forced, or if ``every_s`` has elapsed since the last
+        write (the first cadenced call always writes). Returns whether a
+        write happened.
+
+        A failing write (disk full, path removed mid-run) is counted in
+        :attr:`write_errors` and returns False instead of raising —
+        telemetry must never kill the run it observes. Path/permission
+        misconfigurations still surface early: the CLI resolves and creates
+        the parent directory at construction time."""
+        now = self._clock()
+        due = (
+            self.every_s is not None
+            and (self._last_write is None or now - self._last_write >= self.every_s)
+        )
+        if not (force or due):
+            return False
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(snapshot_json(self.registry, indent=2))
+            os.replace(tmp, self.path)
+        except OSError:
+            self.write_errors += 1
+            return False
+        self._last_write = now
+        self.writes += 1
+        return True

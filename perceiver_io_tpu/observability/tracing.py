@@ -1,0 +1,225 @@
+"""Span tracing: where did this request's (or this step's) latency go?
+
+A :class:`Span` is one named, timed region with attributes; a
+:class:`Tracer` allocates deterministic trace/span IDs, retains finished
+spans in a bounded buffer (test introspection), and optionally streams each
+finished span as one JSON line to a sink (:class:`JsonlSpanSink` →
+``events.jsonl``).
+
+The serving lifecycle threads ONE trace per request through
+``submit → queued → batched → executed → split/complete`` — every submitted
+request ends in exactly one terminal ``serving.request`` span whose
+``status`` is ``ok``/``shed``/``timed_out``/``failed``/``rejected``, which
+is what makes span accounting *closeable*: terminal spans reconcile 1:1
+against ``ServingEngine.stats()`` counters. The trainer emits per-step
+``trainer.data_wait`` / ``trainer.step`` / ``trainer.log_flush`` /
+``trainer.checkpoint`` spans under one trace per ``fit``.
+
+IDs are sequential (``t000001``, ``s000001``), not random: deterministic
+under the chaos harness and trivially joinable from the serve CLI's JSON
+lines. Because the JSONL sink appends, two *processes* writing the same
+events file would collide on restarted IDs — pass a per-run ``prefix``
+(the CLI derives one from the pid + start time) to disambiguate; the
+default stays bare for deterministic tests.
+
+Components take ``tracer=None`` and skip every span site when unset — the
+same zero-cost-when-off contract as the chaos hooks.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed region. ``end_s`` is None while open; ``status`` is set at
+    end time (``ok`` unless the region raised or the caller overrode it)."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_s: float
+    end_s: Optional[float] = None
+    status: str = "open"
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.end_s is None:
+            return None
+        return (self.end_s - self.start_s) * 1e3
+
+    def to_row(self) -> dict:
+        """The events.jsonl line shape."""
+        return {
+            "span": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_s": round(self.start_s, 6),
+            "duration_ms": None if self.duration_ms is None else round(self.duration_ms, 3),
+            "status": self.status,
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+
+class Tracer:
+    """Span factory + finished-span buffer + optional JSONL sink.
+
+    :param clock: monotonic time source (``FakeClock`` for deterministic
+        tests).
+    :param sink: callable receiving each finished span's ``to_row()`` dict —
+        usually a :class:`JsonlSpanSink`. None keeps spans in memory only.
+    :param keep: how many finished spans the in-memory buffer retains.
+    :param prefix: prepended to every trace/span ID. Default "" keeps IDs
+        deterministic for tests; pass a per-run token when several runs
+        append to one events file (trace IDs restart per process).
+    """
+
+    def __init__(self, *, clock: Callable[[], float] = time.monotonic,
+                 sink: Optional[Callable[[dict], None]] = None, keep: int = 8192,
+                 prefix: str = ""):
+        self._clock = clock
+        self._sink = sink
+        self._lock = threading.Lock()
+        self._prefix = prefix
+        self._next_trace = 0
+        self._next_span = 0
+        self.finished: deque = deque(maxlen=keep)
+
+    def now(self) -> float:
+        """The tracer's clock — callers that backdate spans from durations
+        measured on a DIFFERENT clock must translate into this domain
+        (``start_s = tracer.now() - duration``), or span durations mix two
+        time bases (e.g. a FakeClock engine with a wall-clock tracer)."""
+        return self._clock()
+
+    # -- ids ----------------------------------------------------------------
+    def new_trace_id(self) -> str:
+        with self._lock:
+            self._next_trace += 1
+            return f"{self._prefix}t{self._next_trace:06d}"
+
+    def _new_span_id(self) -> str:
+        self._next_span += 1
+        return f"{self._prefix}s{self._next_span:06d}"
+
+    # -- span lifecycle -----------------------------------------------------
+    def start_span(self, name: str, *, trace_id: Optional[str] = None,
+                   parent: Optional[Span] = None,
+                   start_s: Optional[float] = None, **attrs: Any) -> Span:
+        """Open a span. ``start_s`` backdates it (the engine opens a request's
+        terminal span at its recorded submit time)."""
+        with self._lock:
+            span_id = self._new_span_id()
+        if trace_id is None:
+            trace_id = parent.trace_id if parent is not None else self.new_trace_id()
+        return Span(
+            name=name, trace_id=trace_id, span_id=span_id,
+            parent_id=None if parent is None else parent.span_id,
+            start_s=self._clock() if start_s is None else float(start_s),
+            attrs=dict(attrs),
+        )
+
+    def end_span(self, span: Span, status: str = "ok", **attrs: Any) -> Span:
+        span.end_s = self._clock()
+        span.status = status
+        span.attrs.update(attrs)
+        with self._lock:
+            self.finished.append(span)
+            sink = self._sink
+        if sink is not None:
+            sink(span.to_row())
+        return span
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, trace_id: Optional[str] = None,
+             parent: Optional[Span] = None, **attrs: Any):
+        """Context-managed span; a raising body ends it ``status="error"``
+        (and re-raises)."""
+        sp = self.start_span(name, trace_id=trace_id, parent=parent, **attrs)
+        try:
+            yield sp
+        except BaseException:
+            self.end_span(sp, status="error")
+            raise
+        self.end_span(sp)
+
+    def event(self, name: str, *, trace_id: Optional[str] = None,
+              status: str = "ok", start_s: Optional[float] = None,
+              **attrs: Any) -> Span:
+        """A point (or backdated) span ended immediately — terminal request
+        states, shed/rejected submissions."""
+        sp = self.start_span(name, trace_id=trace_id, start_s=start_s, **attrs)
+        return self.end_span(sp, status=status)
+
+    # -- introspection ------------------------------------------------------
+    def spans(self, name: Optional[str] = None,
+              trace_id: Optional[str] = None) -> List[Span]:
+        """Finished spans, optionally filtered — the accounting tests' view."""
+        with self._lock:
+            out = list(self.finished)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+
+class JsonlSpanSink:
+    """Append finished spans to a JSONL file (``events.jsonl``), one line
+    per span, flushed per write so a crashed run still leaves a complete
+    prefix. Rank gating is the caller's job (the trainer only constructs a
+    sink on process 0).
+
+    Write failures (disk full, directory removed mid-run) are counted in
+    :attr:`write_errors`, never raised — telemetry must not kill the run it
+    observes (the same contract as ``SnapshotWriter.maybe_write``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a")
+        self.write_errors = 0
+
+    def __call__(self, row: dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.write(json.dumps(row) + "\n")
+                self._fh.flush()
+            except OSError:
+                self.write_errors += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    self.write_errors += 1
+                self._fh = None
+
+
+def read_events_jsonl(path: str) -> List[dict]:
+    """Parse an events.jsonl file, skipping torn trailing lines (the file is
+    flushed per span, but a SIGKILL can still truncate the last write)."""
+    rows: List[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows

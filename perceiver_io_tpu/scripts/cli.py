@@ -179,6 +179,75 @@ class ServeArgs:
     deadline_s: Optional[float] = None
 
 
+def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
+    """Materialize the ``--obs.*`` flag group (docs/observability.md) into
+    registry / tracer / snapshot-writer / profiler-trigger objects. Every
+    field defaults to off; the events sink, snapshot writer, and profiler
+    trigger are all rank-0 only (non-main processes would race the same
+    files under a shared root dir). Returns ``{"registry", "tracer",
+    "sink", "snapshot_writer", "trigger"}`` — callers must ``close()`` the
+    sink when done."""
+    import os
+
+    from perceiver_io_tpu.observability import (
+        JsonlSpanSink,
+        MetricsRegistry,
+        ProfilerTrigger,
+        SnapshotWriter,
+        Tracer,
+    )
+
+    def _resolve(path: str) -> str:
+        if not os.path.isabs(path):
+            path = os.path.join(root, path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return path
+
+    registry = MetricsRegistry()
+    sink = None
+    tracer = None
+    if obs.events_path is not None and is_main:
+        import time
+
+        sink = JsonlSpanSink(_resolve(obs.events_path))
+        # per-run ID prefix: the sink appends, and a restarted process would
+        # otherwise re-issue t000001... — colliding with the previous run's
+        # spans in the same file and breaking the trace-ID join
+        tracer = Tracer(
+            sink=sink, prefix=f"{os.getpid():x}.{int(time.time()) & 0xFFFFFF:x}."
+        )
+    snapshot_writer = None
+    if (obs.snapshot_every_s is not None or obs.snapshot_path is not None) and is_main:
+        snapshot_writer = SnapshotWriter(
+            registry,
+            _resolve(obs.snapshot_path or "metrics_snapshot.json"),
+            every_s=obs.snapshot_every_s,
+        )
+    trigger = None
+    if obs.profile_on_regress_factor is not None and is_main:
+        if jax.process_count() > 1:
+            # an armed trigger flips process 0 to single-step scheduling
+            # while other processes stay fused — desynchronized collectives
+            # hang the SPMD run. Restricted until arming is rank-broadcast.
+            print(
+                "[obs] profile_on_regress_factor is single-process only; "
+                "disabled for this multi-host run",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            trigger = ProfilerTrigger(
+                os.path.join(root, "profile_regress"),
+                factor=obs.profile_on_regress_factor,
+            )
+    return {
+        "registry": registry,
+        "tracer": tracer,
+        "sink": sink,
+        "snapshot_writer": snapshot_writer,
+        "trigger": trigger,
+    }
+
+
 # -- the CLI ---------------------------------------------------------------
 @dataclasses.dataclass
 class ModelFamily:
@@ -259,6 +328,7 @@ class CLI:
 
     # -- flag space --------------------------------------------------------
     def _known_flags(self, data_cls) -> Dict[str, Any]:
+        from perceiver_io_tpu.observability import ObservabilityArgs
         from perceiver_io_tpu.training.trainer import TrainerConfig
 
         known: Dict[str, Any] = {"config": str, "data": str, "params": str, "ckpt": str}
@@ -267,6 +337,7 @@ class CLI:
         known.update(flag_specs(TrainerConfig, "trainer"))
         known.update(flag_specs(OptimizerArgs, "optimizer"))
         known.update(flag_specs(LRSchedulerArgs, "lr_scheduler"))
+        known.update(flag_specs(ObservabilityArgs, "obs"))
         from perceiver_io_tpu.parallel import MeshConfig
 
         known.update(flag_specs(MeshConfig, "mesh"))
@@ -287,8 +358,11 @@ class CLI:
         if subcommand == "serve":
             # serve needs no datamodule: the checkpoint's embedded config
             # picks the model, and prompts come from a file or stdin.
+            from perceiver_io_tpu.observability import ObservabilityArgs
+
             known = {"ckpt": str, "params": str}
             known.update(flag_specs(ServeArgs, "serve"))
+            known.update(flag_specs(ObservabilityArgs, "obs"))
             return self.run_serve(_parse_dotted(argv[1:], known))
 
         # data module choice first (its ctor defines the --data.* space)
@@ -374,6 +448,12 @@ class CLI:
         )
 
         mesh = make_mesh(build_dataclass(MeshConfig, values, "mesh"))
+        from perceiver_io_tpu.observability import ObservabilityArgs
+
+        obs = build_dataclass(ObservabilityArgs, values, "obs")
+        kit = _obs_kit(
+            obs, trainer_cfg.default_root_dir, is_main=jax.process_index() == 0
+        )
         trainer = Trainer(
             trainer_cfg,
             mesh,
@@ -381,6 +461,10 @@ class CLI:
             tx,
             model_config=model_cfg,
             lr_schedule=schedule,
+            registry=kit["registry"],
+            tracer=kit["tracer"],
+            profiler_trigger=kit["trigger"],
+            snapshot_writer=kit["snapshot_writer"],
         )
 
         first_batch = next(iter(dm.train_dataloader()))
@@ -403,24 +487,33 @@ class CLI:
         elif self.family.initial_params is not None:
             initial = self.family.initial_params(model, model_cfg, dm)
 
-        if subcommand in ("validate", "test"):
-            trainer.setup_state(init_params, initial_params=initial)
-            loader = dm.test_dataloader() if subcommand == "test" else dm.val_dataloader()
-            metrics = trainer.test(loader) if subcommand == "test" else trainer.validate(loader)
+        try:
+            if subcommand in ("validate", "test"):
+                trainer.setup_state(init_params, initial_params=initial)
+                loader = dm.test_dataloader() if subcommand == "test" else dm.val_dataloader()
+                metrics = trainer.test(loader) if subcommand == "test" else trainer.validate(loader)
+                trainer.close()
+                import json as _json
+
+                print(_json.dumps({k: round(float(v), 6) for k, v in metrics.items()}))
+                return metrics
+
+            state = trainer.fit(
+                init_params,
+                dm.train_dataloader(),
+                val_data=dm.val_dataloader,
+                initial_params=initial,
+            )
             trainer.close()
-            import json as _json
-
-            print(_json.dumps({k: round(float(v), 6) for k, v in metrics.items()}))
-            return metrics
-
-        state = trainer.fit(
-            init_params,
-            dm.train_dataloader(),
-            val_data=dm.val_dataloader,
-            initial_params=initial,
-        )
-        trainer.close()
-        return state
+            return state
+        finally:
+            # validate/test never reach fit's own forced write — the flag
+            # must not be silently ignored on those subcommands (fit already
+            # wrote; a second identical write is harmless)
+            if kit["snapshot_writer"] is not None:
+                kit["snapshot_writer"].maybe_write(force=True)
+            if kit["sink"] is not None:
+                kit["sink"].close()
 
     # -- serving -----------------------------------------------------------
     def run_serve(self, values: Dict[str, Any]) -> list:
@@ -436,12 +529,14 @@ class CLI:
         requests surface their status per line.
         """
         import json
+        import os
         import time
 
         from perceiver_io_tpu.data.text.tokenizers import ByteTokenizer
         from perceiver_io_tpu.inference.generate import GenerationConfig
         from perceiver_io_tpu.inference.samplers import SamplingConfig
         from perceiver_io_tpu.models import model_for_config
+        from perceiver_io_tpu.observability import ObservabilityArgs, Tracer
         from perceiver_io_tpu.serving import BucketTable, QueueFull, ServingEngine
         from perceiver_io_tpu.training.checkpoint import load_pretrained
 
@@ -449,6 +544,19 @@ class CLI:
         if not ckpt:
             raise SystemExit("serve requires --ckpt <save_pretrained dir>")
         args = build_dataclass(ServeArgs, values, "serve")
+        obs = build_dataclass(ObservabilityArgs, values, "obs")
+        if obs.profile_on_regress_factor is not None:
+            # only the trainer loop feeds a ProfilerTrigger; silently
+            # accepting the flag here would look configured while doing
+            # nothing
+            raise SystemExit(
+                "--obs.profile_on_regress_factor applies to fit, not serve"
+            )
+        kit = _obs_kit(obs, os.getcwd())
+        # serve lines always carry a trace_id (the events.jsonl join key),
+        # so the engine always gets a tracer — sink-less when --obs.events_path
+        # is unset (spans stay in the bounded in-memory buffer).
+        tracer = kit["tracer"] or Tracer()
         params, model_cfg = load_pretrained(ckpt)
         if model_cfg is None:
             raise SystemExit(f"{ckpt} has no embedded model config")
@@ -483,6 +591,8 @@ class CLI:
             rng=jax.random.PRNGKey(args.seed),
             max_queue=args.max_queue,
             default_deadline_s=args.deadline_s,
+            registry=kit["registry"],
+            tracer=tracer,
         )
         if args.warmup:
             t0 = time.monotonic()
@@ -500,9 +610,26 @@ class CLI:
         if not prompts:
             raise SystemExit("serve: no prompts (empty file/stdin)")
 
+        try:
+            return self._serve_prompts(engine, tok, prompts, args, kit)
+        finally:
+            # fit's teardown parity: even an exception mid-drain leaves a
+            # final snapshot and a closed events file
+            if kit["snapshot_writer"] is not None:
+                kit["snapshot_writer"].maybe_write(force=True)
+            if kit["sink"] is not None:
+                kit["sink"].close()
+
+    def _serve_prompts(self, engine, tok, prompts, args, kit) -> list:
+        import json
+        import time
+
+        from perceiver_io_tpu.serving import QueueFull
+
         t0 = time.monotonic()
         pad_id = tok.pad_token_id or 0
-        handles: list = []  # (prompt, ServeRequest | None, error | None)
+        # (prompt, ServeRequest | None, error | None, trace_id | None, status)
+        handles: list = []
         for p in prompts:
             ids = np.asarray(tok.encode(p), np.int32)
             try:
@@ -511,23 +638,43 @@ class CLI:
                 # (shed should count true rejections, not this retry loop)
                 while not engine.health()["ready"] and engine.step():
                     pass
-                handles.append((p, engine.submit(ids), None))
+                req = engine.submit(ids)
+                handles.append((p, req, None, req.trace_id, None))
             except (ValueError, QueueFull) as e:
-                # reject this line, keep serving the rest
-                handles.append((p, None, f"{type(e).__name__}: {e}"))
-        engine.drain()
+                # reject/shed this line, keep serving the rest; the engine
+                # already emitted this submission's terminal span — carry its
+                # trace ID (and the SAME terminal status the span/counters
+                # use) so the error record joins against events.jsonl
+                handles.append(
+                    (p, None, f"{type(e).__name__}: {e}",
+                     getattr(e, "trace_id", None),
+                     "shed" if isinstance(e, QueueFull) else "rejected")
+                )
+            if kit["snapshot_writer"] is not None:
+                kit["snapshot_writer"].maybe_write()
+        # CLI-driven drain (not the blocking engine.drain()): the snapshot
+        # cadence must keep firing while the queue — the bulk of the run's
+        # wall time — generates, or a mid-run poller sees stale telemetry
+        while engine.step():
+            if kit["snapshot_writer"] is not None:
+                kit["snapshot_writer"].maybe_write()
+        engine.drain()  # queue already empty: just stop accepting
         wall_s = time.monotonic() - t0
 
         results = []
-        for p, req, error in handles:
+        for p, req, error, trace_id, status in handles:
             if req is not None and req.status == "ok":
                 completion = tok.decode([t for t in req.result.tolist() if t != pad_id])
-                results.append({"prompt": p, "completion": completion})
+                results.append({
+                    "prompt": p, "completion": completion,
+                    "status": "ok", "trace_id": trace_id,
+                })
             else:
                 results.append({
                     "prompt": p,
                     "error": error if req is None else (req.error or req.status),
-                    "status": "rejected" if req is None else req.status,
+                    "status": status if req is None else req.status,
+                    "trace_id": trace_id,
                 })
         for row in results:
             print(json.dumps(row), flush=True)
@@ -535,16 +682,20 @@ class CLI:
             stats = engine.stats()
             stats["health"] = engine.health()
             stats["wall_s"] = round(wall_s, 3)
+            stats["metrics"] = engine.registry.snapshot()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return results
 
     def _print_help(self) -> None:
         print(f"usage: {self.family.name} {{fit|validate|test|preproc|serve}} [--flag=value ...]")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
-              "--lr_scheduler.* --config=<yaml> --data=<name> --ckpt=<dir>")
+              "--lr_scheduler.* --obs.* --config=<yaml> --data=<name> --ckpt=<dir>")
         print("serve: --ckpt=<dir> --serve.prompts=<file|stdin> --serve.max_new_tokens "
               "--serve.prompt_buckets --serve.batch_buckets --serve.warmup "
               "--serve.max_queue --serve.deadline_s")
+        print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "
+              "--obs.snapshot_path --obs.profile_on_regress_factor "
+              "(docs/observability.md)")
         print(f"data modules: {sorted(self.family.data_registry)}")
 
 

@@ -17,10 +17,18 @@ load-path layer between "a jitted ``generate()``" and "a service":
   split back per request.
 - **Warmup** — :meth:`ServingEngine.warmup` compiles every bucket before
   traffic is accepted.
-- **Observability** — the executor cache's hit/miss/evict counters
-  (``generate.executor_cache_stats``) plus queue-wait percentiles surface
-  in :meth:`ServingEngine.stats`, so residual retracing is measured, never
-  silent.
+- **Observability** (docs/observability.md) — every counter lives on a
+  :class:`~perceiver_io_tpu.observability.MetricsRegistry` under canonical
+  Prometheus-style names (``serving_requests_completed_total``, ...), with
+  queue-wait / batch-assembly / device-execute histograms; an optional
+  :class:`~perceiver_io_tpu.observability.Tracer` threads one trace per
+  request through ``submit → queued → batched → executed → split/complete``
+  so every submitted request ends in exactly one terminal
+  ``serving.request`` span (status ``ok``/``shed``/``timed_out``/
+  ``failed``/``rejected``). The executor cache's hit/miss/evict counters
+  (``generate.executor_cache_stats``) surface in
+  :meth:`ServingEngine.stats` too, so residual retracing is measured,
+  never silent.
 
 Exactness: generation is left-pad invariant (padded keys are masked out of
 every softmax; ``tests/test_generate.py`` pins padded == unpadded against
@@ -61,8 +69,26 @@ from perceiver_io_tpu.inference.generate import (
     executor_cache_stats,
     generate,
 )
+from perceiver_io_tpu.observability import MetricsRegistry, Tracer
 from perceiver_io_tpu.reliability import QueueFull
 from perceiver_io_tpu.serving.buckets import BucketTable
+
+#: canonical registry counter names -> the legacy ``stats()`` keys they
+#: replace (kept as deprecation aliases; docs/observability.md)
+STAT_ALIASES = {
+    "serving_requests_submitted_total": "requests",
+    "serving_requests_completed_total": "completed",
+    "serving_requests_shed_total": "shed",
+    "serving_requests_timed_out_total": "timed_out",
+    "serving_requests_failed_total": "failed",
+    "serving_requests_rejected_total": "rejected",
+    "serving_batches_total": "batches",
+    "serving_tokens_generated_total": "tokens_generated",
+}
+
+
+def _round_ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 3)
 
 
 @dataclass
@@ -84,6 +110,9 @@ class ServeRequest:
     result: Optional[np.ndarray] = None  # (max_new_tokens,) ids, pad after EOS
     status: str = "queued"  # queued | ok | timed_out | failed
     error: Optional[str] = None
+    #: per-request trace ID (None when the engine has no tracer) — the join
+    #: key between the serve CLI's JSON lines and events.jsonl
+    trace_id: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -111,6 +140,12 @@ class ServingEngine:
     :param chaos: optional fault-injection registry
         (:class:`~perceiver_io_tpu.reliability.ChaosRegistry`); None skips
         every hook.
+    :param registry: metrics registry the engine's counters/histograms live
+        on. Defaults to a private one (two engines must not double-count);
+        pass a shared registry for unified export (the serve CLI does).
+    :param tracer: optional span tracer — one trace per request, one
+        terminal ``serving.request`` span per submission, one
+        ``serving.batch`` span per micro-batch. None skips every span site.
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
@@ -118,7 +153,9 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 chaos=None):
+                 chaos=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         self.model = model
         self.params = params
         self.config = config or GenerationConfig()
@@ -140,16 +177,16 @@ class ServingEngine:
         self._next_id = 0
         self._accepting = True
         self._cache0 = executor_cache_stats()
-        self._waits_ms: List[float] = []
-        self._batches = 0
-        self._requests = 0
-        self._completed = 0
-        self._shed = 0
-        self._timed_out = 0
-        self._failed = 0
-        self._tokens_generated = 0
-        self._real_prompt_tokens = 0
-        self._padded_prompt_tokens = 0
+        # One source of truth for every counter/histogram (the old private
+        # _completed/_shed/... ints). stats() reads these back and also
+        # exposes the legacy key names as aliases.
+        self.registry = registry if registry is not None else MetricsRegistry(clock=clock)
+        self.registry.declare_counters(
+            *STAT_ALIASES,
+            "serving_prompt_tokens_real_total",
+            "serving_prompt_tokens_padded_total",
+        )
+        self.tracer = tracer
 
     # -- queue front --------------------------------------------------------
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
@@ -164,34 +201,56 @@ class ServingEngine:
         if not self._accepting:
             raise RuntimeError("engine is draining; new submissions rejected")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size == 0:
-            raise ValueError("cannot serve an empty prompt")
-        if prompt.size > self.table.prompt_lens[-1]:
-            raise ValueError(
-                f"prompt length {prompt.size} exceeds the largest bucket "
-                f"{self.table.prompt_lens[-1]}; extend the bucket table or "
-                "truncate the prompt"
-            )
         cfg = config or self.config
-        self._pick_prompt_bucket(int(prompt.size), cfg)  # fail fast, not mid-batch
+        try:
+            if prompt.size == 0:
+                raise ValueError("cannot serve an empty prompt")
+            if prompt.size > self.table.prompt_lens[-1]:
+                raise ValueError(
+                    f"prompt length {prompt.size} exceeds the largest bucket "
+                    f"{self.table.prompt_lens[-1]}; extend the bucket table or "
+                    "truncate the prompt"
+                )
+            self._pick_prompt_bucket(int(prompt.size), cfg)  # fail fast, not mid-batch
+        except ValueError as e:
+            # infeasible submissions still get a terminal span + counter so
+            # the CLI's per-line error records join against events.jsonl
+            self.registry.inc("serving_requests_rejected_total")
+            e.trace_id = self._terminal_event("rejected", error=str(e))
+            raise
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
-            self._shed += 1
-            raise QueueFull(
+            self.registry.inc("serving_requests_shed_total")
+            exc = QueueFull(
                 f"queue depth {len(self._queue)} is at max_queue="
                 f"{self.max_queue}; request shed — drain with step() or "
                 "retry after backoff"
             )
+            exc.trace_id = self._terminal_event(
+                "shed", queue_depth=len(self._queue)
+            )
+            raise exc
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         now = self._clock()
         req = ServeRequest(
             self._next_id, prompt, cfg, now,
             deadline_at=None if deadline_s is None else now + deadline_s,
+            trace_id=self.tracer.new_trace_id() if self.tracer else None,
         )
         self._next_id += 1
         self._queue.append(req)
-        self._requests += 1
+        self.registry.inc("serving_requests_submitted_total")
         return req
+
+    def _terminal_event(self, status: str, **attrs) -> Optional[str]:
+        """Emit a terminal ``serving.request`` span for a submission that
+        never became a queue entry (shed / rejected); returns its trace ID
+        so the raising path can attach it to the exception."""
+        if self.tracer is None:
+            return None
+        trace_id = self.tracer.new_trace_id()
+        self.tracer.event("serving.request", trace_id=trace_id, status=status, **attrs)
+        return trace_id
 
     def serve(self, prompts: Sequence, config: Optional[GenerationConfig] = None,
               *, rng: Optional[jax.Array] = None) -> List[Optional[np.ndarray]]:
@@ -238,11 +297,29 @@ class ServingEngine:
         req.status = status
         req.error = error
         if status == "ok":
-            self._completed += 1
+            self.registry.inc("serving_requests_completed_total")
         elif status == "timed_out":
-            self._timed_out += 1
+            self.registry.inc("serving_requests_timed_out_total")
         elif status == "failed":
-            self._failed += 1
+            self.registry.inc("serving_requests_failed_total")
+        now = self._clock()
+        latency_s = now - req.submitted_at
+        self.registry.observe("serving_request_latency_ms", latency_s * 1e3)
+        if self.tracer is not None:
+            # the request's ONE terminal span: submit time -> disposition.
+            # The latency was measured on the ENGINE clock; backdate in the
+            # tracer's own clock domain so the span duration stays correct
+            # even when the two clocks differ (FakeClock engine + wall-clock
+            # tracer, or vice versa).
+            span = self.tracer.start_span(
+                "serving.request", trace_id=req.trace_id,
+                start_s=self.tracer.now() - latency_s,
+                request_id=req.request_id,
+                prompt_len=int(req.prompt.size),
+            )
+            self.tracer.end_span(
+                span, status=status, **({"error": error} if error else {})
+            )
 
     def _expire_overdue(self) -> int:
         """Complete every queue entry past its deadline as ``timed_out`` so
@@ -330,6 +407,7 @@ class ServingEngine:
 
         b = self.table.batch_bucket(len(picked))
         length = self._pick_prompt_bucket(max(r.prompt.size for r in picked), cfg)
+        assemble_t0 = self._clock()
         ids = np.full((b, length), cfg.pad_token_id, np.int32)
         # Dummy filler rows claim zero pads — a full-width "prompt" of pad-id
         # tokens whose output is computed and dropped. Zero, not length-1:
@@ -344,10 +422,22 @@ class ServingEngine:
             ids[i, length - req.prompt.size:] = req.prompt
             pad_count[i] = length - req.prompt.size
             req.started_at = now
-            self._waits_ms.append((now - req.submitted_at) * 1e3)
+            self.registry.observe(
+                "serving_queue_wait_ms", (now - req.submitted_at) * 1e3
+            )
 
         self._rng, key = jax.random.split(self._rng)
-        self._batches += 1
+        batch_index = int(self.registry.inc("serving_batches_total"))
+        assemble_ms = (self._clock() - assemble_t0) * 1e3
+        self.registry.observe("serving_batch_assembly_ms", assemble_ms)
+        batch_span = None
+        if self.tracer is not None:
+            batch_span = self.tracer.start_span(
+                "serving.batch", batch_index=batch_index, size=len(picked),
+                bucket=[b, length], assemble_ms=round(assemble_ms, 3),
+                trace_ids=[r.trace_id for r in picked],
+            )
+        execute_t0 = self._clock()
         try:
             batch_fault = self._chaos.hit("serving.batch") if self._chaos else None
             if batch_fault is not None and batch_fault.kind == "error":
@@ -360,15 +450,33 @@ class ServingEngine:
             )
         except Exception as e:
             # Executor failure: this micro-batch fails, the queue survives.
+            self.registry.observe(
+                "serving_device_execute_ms", (self._clock() - execute_t0) * 1e3
+            )
+            if batch_span is not None:
+                self.tracer.end_span(
+                    batch_span, status="failed", error=f"{type(e).__name__}: {e}"
+                )
             for req in picked:
                 self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
             return disposed + len(picked)
+        # np.asarray above materialized the result, so this is device time
+        # plus dispatch — the per-batch execute phase of the trace.
+        execute_ms = (self._clock() - execute_t0) * 1e3
+        self.registry.observe("serving_device_execute_ms", execute_ms)
+        if batch_span is not None:
+            self.tracer.end_span(batch_span, execute_ms=round(execute_ms, 3))
         for i, req in enumerate(picked):
             req.result = out[i]
             self._finish(req, "ok")
-        self._tokens_generated += len(picked) * cfg.max_new_tokens
-        self._real_prompt_tokens += sum(int(r.prompt.size) for r in picked)
-        self._padded_prompt_tokens += b * length
+        self.registry.inc(
+            "serving_tokens_generated_total", len(picked) * cfg.max_new_tokens
+        )
+        self.registry.inc(
+            "serving_prompt_tokens_real_total",
+            sum(int(r.prompt.size) for r in picked),
+        )
+        self.registry.inc("serving_prompt_tokens_padded_total", b * length)
         return disposed + len(picked)
 
     # -- ahead-of-time warmup ----------------------------------------------
@@ -401,36 +509,44 @@ class ServingEngine:
 
     # -- observability ------------------------------------------------------
     def stats(self) -> dict:
-        """Serving counters since engine construction. ``compiles`` is the
-        executor-cache miss delta — the engine assumes it owns the process's
-        generation traffic over its lifetime (true for the CLI, bench probe,
-        and tests)."""
+        """Serving counters since engine construction, read back from the
+        metrics registry (the one source of truth). Every counter appears
+        under its canonical registry name (``serving_*_total``) AND its
+        legacy short key (``completed``, ``shed``, ... — deprecation
+        aliases; see ``STAT_ALIASES`` / docs/observability.md).
+
+        ``compiles`` is the executor-cache miss delta — the engine assumes
+        it owns the process's generation traffic over its lifetime (true for
+        the CLI, bench probe, and tests)."""
         cache_now = executor_cache_stats()
         # clamp at 0: reset_executor_caches() mid-lifetime rewinds the global
         # counters below this engine's construction-time snapshot
         cache = {k: max(0, cache_now[k] - self._cache0[k]) for k in cache_now}
-        waits = sorted(self._waits_ms)
-
-        def pct(p: float) -> Optional[float]:
-            if not waits:
-                return None
-            return round(waits[min(len(waits) - 1, int(round(p / 100.0 * (len(waits) - 1))))], 3)
-
+        reg = self.registry
+        # one consistent read, not 16 separate ones: a scrape thread polling
+        # stats() mid-step must still see alias == canonical for every pair
+        # (counters(), not snapshot() — no histogram sorting under the lock)
+        counts = reg.counters()
+        counters = {
+            alias: int(counts.get(name, 0)) for name, alias in STAT_ALIASES.items()
+        }
+        counters.update(
+            {name: int(counts.get(name, 0)) for name in STAT_ALIASES}
+        )
+        real = counts.get("serving_prompt_tokens_real_total", 0)
+        padded = counts.get("serving_prompt_tokens_padded_total", 0)
         return {
-            "requests": self._requests,
-            "batches": self._batches,
+            **counters,
             "queued": len(self._queue),
-            "completed": self._completed,
-            "shed": self._shed,
-            "timed_out": self._timed_out,
-            "failed": self._failed,
             "compiles": cache["misses"],
             "executor_cache": cache,
-            "queue_wait_ms": {"p50": pct(50.0), "p95": pct(95.0)},
-            "tokens_generated": self._tokens_generated,
-            "prompt_padding_efficiency": round(
-                self._real_prompt_tokens / max(1, self._padded_prompt_tokens), 4
-            ),
+            # registry.percentile is the LOCKED accessor — stats() may be
+            # polled from a scrape thread while the owner thread observes
+            "queue_wait_ms": {
+                "p50": _round_ms(reg.percentile("serving_queue_wait_ms", 50.0)),
+                "p95": _round_ms(reg.percentile("serving_queue_wait_ms", 95.0)),
+            },
+            "prompt_padding_efficiency": round(real / max(1, padded), 4),
             "bucket_grid": {
                 "prompt_lens": list(self.table.prompt_lens),
                 "batch_sizes": list(self.table.batch_sizes),
@@ -443,6 +559,7 @@ class ServingEngine:
         ``max_queue``). Cheap — no device work, no cache reads."""
         now = self._clock()
         depth = len(self._queue)
+        reg = self.registry
         return {
             "ready": self._accepting
             and (self.max_queue is None or depth < self.max_queue),
@@ -452,8 +569,8 @@ class ServingEngine:
             "oldest_wait_ms": round(
                 max((now - r.submitted_at) for r in self._queue) * 1e3, 3
             ) if self._queue else 0.0,
-            "completed": self._completed,
-            "shed": self._shed,
-            "timed_out": self._timed_out,
-            "failed": self._failed,
+            "completed": int(reg.counter("serving_requests_completed_total")),
+            "shed": int(reg.counter("serving_requests_shed_total")),
+            "timed_out": int(reg.counter("serving_requests_timed_out_total")),
+            "failed": int(reg.counter("serving_requests_failed_total")),
         }

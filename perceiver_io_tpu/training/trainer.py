@@ -14,6 +14,7 @@ implicit: metric arrays are replicated outputs of the sharded step).
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -27,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from perceiver_io_tpu.observability import MetricsRegistry
 
 from perceiver_io_tpu.parallel import (
     TrainState,
@@ -260,6 +263,20 @@ class Trainer:
         (:class:`~perceiver_io_tpu.reliability.ChaosRegistry`); consulted
         once per optimizer step at the ``trainer.step`` site. None (the
         default) skips the hook entirely.
+    :param registry: metrics registry the trainer's counters/histograms live
+        on (``trainer_steps_total``, ``trainer_step_ms``, fault counters...);
+        defaults to a private one (docs/observability.md).
+    :param tracer: optional :class:`~perceiver_io_tpu.observability.Tracer`
+        — one trace per ``fit`` with per-step ``trainer.data_wait`` /
+        ``trainer.step`` / ``trainer.log_flush`` / ``trainer.checkpoint``
+        spans. None skips every span site.
+    :param profiler_trigger: optional
+        :class:`~perceiver_io_tpu.observability.ProfilerTrigger` — fed each
+        single step's host time; when the p95 regresses, the next step runs
+        under a ``jax.profiler`` capture.
+    :param snapshot_writer: optional
+        :class:`~perceiver_io_tpu.observability.SnapshotWriter` — cadence
+        checked at every log flush, forced once at ``fit`` exit.
     """
 
     def __init__(
@@ -273,6 +290,10 @@ class Trainer:
         lr_schedule: Optional[optax.Schedule] = None,
         callbacks: Sequence[Callable] = (),
         chaos=None,
+        registry: Optional[MetricsRegistry] = None,
+        tracer=None,
+        profiler_trigger=None,
+        snapshot_writer=None,
     ):
         self.config = config
         self.mesh = mesh
@@ -289,7 +310,20 @@ class Trainer:
         self._metrics_file = None
         self._chaos = chaos
         self._policy = _effective_non_finite_policy(config)
-        #: fault-recovery counters for this trainer's lifetime
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.registry.declare_counters(
+            "trainer_steps_total",
+            "trainer_skipped_steps_total",
+            "trainer_rollbacks_total",
+            "trainer_callback_errors_total",
+        )
+        self._tracer = tracer
+        self._fit_trace: Optional[str] = None
+        self._profiler_trigger = profiler_trigger
+        self._snapshot_writer = snapshot_writer
+        #: fault-recovery counters for this trainer's lifetime (kept as a
+        #: plain dict for compatibility; each increment is mirrored onto the
+        #: registry under ``trainer_*_total``)
         self.fault_stats = {"skipped_steps": 0, "rollbacks": 0, "callback_errors": 0}
 
         if config.enable_checkpointing:
@@ -305,6 +339,32 @@ class Trainer:
     def is_main_process(self) -> bool:
         """``rank_zero_only`` parity (reference ``clm/lightning.py:113``)."""
         return jax.process_index() == 0
+
+    def _span(self, name: str, **attrs):
+        """A span under this fit's trace, or a no-op when tracing is off —
+        the zero-cost-when-unset contract the chaos hooks follow."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name, trace_id=self._fit_trace, **attrs)
+
+    def _count_fault(self, key: str) -> None:
+        """Increment one ``fault_stats`` counter and its registry mirror."""
+        self.fault_stats[key] += 1
+        self.registry.inc(f"trainer_{key}_total")
+
+    def _record_step_time(self, step_ms: float, trigger) -> None:
+        """One home for the fenced/dispatch metric-name split and the
+        trigger feed — the fused and single-step paths must never diverge
+        on it. Without a trigger nothing syncs per step, so the honest
+        export name is dispatch time; the fenced name only exists when the
+        trigger forced the per-step sync."""
+        self.registry.observe(
+            "trainer_step_ms" if trigger is not None
+            else "trainer_step_dispatch_ms",
+            step_ms,
+        )
+        if trigger is not None:
+            trigger.observe(step_ms)
 
     def _open_writers(self) -> None:
         """(Re)open the rank-0 metrics JSONL + TensorBoard writers — called
@@ -349,10 +409,17 @@ class Trainer:
 
     def log_text(self, step: int, tag: str, text: str) -> None:
         """Qualitative text logging (generated samples, filled masks) — the
-        reference renders these into TensorBoard text panels."""
+        reference renders these into TensorBoard text panels.
+
+        Schema: text events are namespaced under one ``"text"`` key
+        (``{"step": N, "text": {tag: text}}``) so metrics.jsonl scalar rows
+        stay all-float and parsers never type-sniff per value. Old mixed
+        files read back through ``observability.read_metrics_jsonl``."""
         if not self.is_main_process or self._metrics_file is None:
             return
-        self._metrics_file.write(json.dumps({"step": step, tag: text}) + "\n")
+        self._metrics_file.write(
+            json.dumps({"step": step, "text": {tag: text}}) + "\n"
+        )
         self._metrics_file.flush()
         if self._tb is not None:
             self._tb.add_text(tag, text, step)
@@ -404,6 +471,9 @@ class Trainer:
         finally:
             # deterministic log teardown: metrics.jsonl and the TB writer are
             # complete and closed on every exit path, crash included
+            if self._snapshot_writer is not None:
+                # never raises: a full disk must not mask the fit's outcome
+                self._snapshot_writer.maybe_write(force=True)
             self._close_writers()
             if prev_handler is not None:
                 import signal
@@ -510,6 +580,9 @@ class Trainer:
         if self._policy in ("skip", "rollback"):
             # recovering policies check (and may discard) every step singly
             return False
+        if self._profiler_trigger is not None and self._profiler_trigger.armed:
+            # an armed p95-regression capture traces ONE representative step
+            return False
         for idx in range(start, start + k - 1):
             if resume_mgr is not None and idx % cfg.save_state_every_n_steps == 0:
                 return False
@@ -556,7 +629,7 @@ class Trainer:
             )
         self.state = resume_mgr.restore_latest(self.state)
         stream.rewind_to(snap_step)
-        self.fault_stats["rollbacks"] += 1
+        self._count_fault("rollbacks")
         self.log_metrics(
             step_idx,
             {"rollback_to_step": snap_step, "rollbacks": self.fault_stats["rollbacks"]},
@@ -569,6 +642,9 @@ class Trainer:
         window: list = []
         profiling = False
         t0 = time.time()
+        if self._tracer is not None:
+            self._fit_trace = self._tracer.new_trace_id()
+        trigger = self._profiler_trigger
         self._bad_streak = 0
         self._rollbacks_this_fit = 0
         snap_after_recovery = False
@@ -590,7 +666,8 @@ class Trainer:
                     cfg, step_idx, k_exec, val_data, resume_mgr
                 ):
                     # one device program for k_exec steps (amortized dispatch)
-                    block = [stream.next() for _ in range(k_exec)]
+                    with self._span("trainer.data_wait", step=step_idx, batches=k_exec):
+                        block = [stream.next() for _ in range(k_exec)]
                     _check_uniform_block(block, k_exec)
                     stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *block)
                     stacked = shard_or_assemble(
@@ -599,14 +676,29 @@ class Trainer:
                     rngs = jnp.stack(
                         [jax.random.fold_in(rng, step_idx + i) for i in range(k_exec)]
                     )
-                    self.state, stacked_metrics = multi_step(self.state, stacked, rngs)
+                    block_t0 = time.perf_counter()
+                    with self._span(
+                        "trainer.step", step=step_idx, fused=k_exec,
+                        measures="fenced" if trigger is not None else "dispatch",
+                    ):
+                        self.state, stacked_metrics = multi_step(self.state, stacked, rngs)
+                        if trigger is not None:
+                            # the trigger needs real step time, not async
+                            # dispatch time — fence the block (its cost is
+                            # amortized over k_exec steps)
+                            jax.block_until_ready(stacked_metrics["loss"])
+                    self.registry.inc("trainer_steps_total", k_exec)
+                    self._record_step_time(
+                        (time.perf_counter() - block_t0) * 1e3 / k_exec, trigger
+                    )
                     per_step = [
                         {k: v[i] for k, v in stacked_metrics.items()}
                         for i in range(k_exec)
                     ]
                     n_ran = k_exec
                 else:
-                    batch = stream.next()
+                    with self._span("trainer.data_wait", step=step_idx):
+                        batch = stream.next()
                     # fold_in (not sequential split): step k's rng is a pure
                     # function of (seed, k), so a resumed run replays the
                     # identical dropout/augmentation stream
@@ -622,7 +714,39 @@ class Trainer:
                     prev_state = (
                         self.state if self._policy in ("skip", "rollback") else None
                     )
-                    self.state, metrics = train_step(self.state, batch, step_rng)
+                    # p95-regression capture: the trigger armed on a previous
+                    # step's time, so THIS (representative) step is traced
+                    # an armed capture must wait out an active profile_start
+                    # trace: jax.profiler allows one session at a time, and
+                    # nesting would kill the run the telemetry observes
+                    capture = (
+                        trigger.capture(step=step_idx)
+                        if trigger is not None and trigger.armed and not profiling
+                        else contextlib.nullcontext()
+                    )
+                    step_t0 = time.perf_counter()
+                    # the `measures` attr is the span-side analog of the
+                    # trainer_step_ms / trainer_step_dispatch_ms split: an
+                    # unfenced step span times async dispatch, and the device
+                    # work it launched surfaces later under log_flush's value
+                    # fetch — readers must not attribute it there
+                    with capture, self._span(
+                        "trainer.step", step=step_idx,
+                        measures="fenced" if trigger is not None else "dispatch",
+                    ):
+                        self.state, metrics = train_step(self.state, batch, step_rng)
+                        if trigger is not None:
+                            # a per-step fence: without it step_ms would be
+                            # async-dispatch microseconds and the trigger
+                            # could never see a real device regression (and
+                            # an armed capture would trace only dispatch).
+                            # The sync cost is the same one skip/rollback
+                            # already pay — the price of opting in.
+                            jax.block_until_ready(metrics["loss"])
+                    self.registry.inc("trainer_steps_total")
+                    self._record_step_time(
+                        (time.perf_counter() - step_t0) * 1e3, trigger
+                    )
                     per_step = [metrics]
                     n_ran = 1
                     if profiling and step_idx >= cfg.profile_start + _PROFILE_WINDOW - 1:
@@ -666,7 +790,7 @@ class Trainer:
                             )
                         # skip: discard the bad update, keep last-good state
                         self.state = prev_state
-                        self.fault_stats["skipped_steps"] += 1
+                        self._count_fault("skipped_steps")
                         snap_after_recovery = True
                         self.log_metrics(
                             step_idx,
@@ -690,14 +814,22 @@ class Trainer:
 
                 def flush_window(step_idx=step_idx):
                     nonlocal window, t0
-                    mean = {
-                        k: float(np.mean([float(m[k]) for m in window]))
-                        for k in window[0]
-                    }
-                    if self.lr_schedule is not None:
-                        mean["lr"] = float(self.lr_schedule(step_idx))
-                    mean["steps_per_sec"] = len(window) / (time.time() - t0)
-                    self.log_metrics(step_idx, mean, prefix="train/")
+                    with self._span("trainer.log_flush", step=step_idx):
+                        mean = {
+                            k: float(np.mean([float(m[k]) for m in window]))
+                            for k in window[0]
+                        }
+                        if self.lr_schedule is not None:
+                            mean["lr"] = float(self.lr_schedule(step_idx))
+                        mean["steps_per_sec"] = len(window) / (time.time() - t0)
+                        self.registry.set_gauge(
+                            "trainer_steps_per_sec", mean["steps_per_sec"]
+                        )
+                        if "loss" in mean and np.isfinite(mean["loss"]):
+                            self.registry.set_gauge("trainer_loss", mean["loss"])
+                        self.log_metrics(step_idx, mean, prefix="train/")
+                    if self._snapshot_writer is not None:
+                        self._snapshot_writer.maybe_write()
                     window, t0 = [], time.time()
                     if self._policy == "halt" and not np.isfinite(
                         mean.get("loss", 0.0)
@@ -725,7 +857,10 @@ class Trainer:
                     # be finite while the update just overflowed — check the
                     # post-update state itself before persisting it
                     if self._policy == "off" or _params_finite(self.state.params):
-                        resume_mgr.save(step_idx, self.state)
+                        with self._span(
+                            "trainer.checkpoint", step=step_idx, kind="resume"
+                        ):
+                            resume_mgr.save(step_idx, self.state)
                         snap_after_recovery = False
                     elif self._policy == "rollback":
                         # don't kill a run whose own policy can recover: skip
@@ -750,12 +885,15 @@ class Trainer:
                     val_metrics = self.validate(val_data())
                     self.log_metrics(step_idx, val_metrics, prefix="val/")
                     if self._ckpt is not None and "loss" in val_metrics:
-                        self._ckpt.save(
-                            step_idx,
-                            self.state.params,
-                            self.model_config,
-                            val_metrics["loss"],
-                        )
+                        with self._span(
+                            "trainer.checkpoint", step=step_idx, kind="best"
+                        ):
+                            self._ckpt.save(
+                                step_idx,
+                                self.state.params,
+                                self.model_config,
+                                val_metrics["loss"],
+                            )
                     for cb in self.callbacks:
                         if self.is_main_process:
                             # a broken qualitative-sampling callback must not
@@ -764,7 +902,7 @@ class Trainer:
                             try:
                                 cb(self, self.state, step_idx, val_metrics)
                             except Exception:
-                                self.fault_stats["callback_errors"] += 1
+                                self._count_fault("callback_errors")
                                 name = getattr(cb, "__name__", repr(cb))
                                 print(
                                     f"[trainer] validation callback {name} "

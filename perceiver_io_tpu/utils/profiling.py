@@ -47,10 +47,16 @@ class StepTimer:
         iters: int = 20,
         flops_per_step: Optional[int] = None,
         peak_flops: Optional[float] = None,
+        registry=None,
+        name: str = "step_timer",
     ) -> dict:
         """:param step_fn: zero-arg callable returning device output(s).
         :param flops_per_step: if given, report achieved FLOP/s.
         :param peak_flops: if also given, report MFU against it.
+        :param registry: optional
+            :class:`~perceiver_io_tpu.observability.MetricsRegistry` — the
+            measured numbers are published as ``<name>_*`` gauges so bench
+            timing exports through the same path as live telemetry.
         """
         for _ in range(self.warmup):
             jax.block_until_ready(step_fn())
@@ -66,4 +72,11 @@ class StepTimer:
             result["flops_per_sec"] = flops_per_step / dt
             if peak_flops:
                 result["mfu"] = flops_per_step / dt / peak_flops
+        if registry is not None:
+            registry.set_gauge(f"{name}_step_time_ms", dt * 1e3)
+            registry.set_gauge(f"{name}_steps_per_sec", result["steps_per_sec"])
+            if "flops_per_sec" in result:
+                registry.set_gauge(f"{name}_flops_per_sec", result["flops_per_sec"])
+            if "mfu" in result:
+                registry.set_gauge(f"{name}_mfu", result["mfu"])
         return result

@@ -40,6 +40,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "observability: unified telemetry layer test (registry/tracing/"
+        "exporters; docs/observability.md); CPU-fast, runs in the tier-1 suite",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )

@@ -222,8 +222,14 @@ def test_sampling_callback_logs_text(tmp_path):
         val_data=lambda: [batch],
     )
     trainer.close()
+    # text events are namespaced under the "text" key (docs/observability.md);
+    # the compat reader normalizes old and new schema alike
     lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
-    assert any("samples/generated" in l for l in lines)
+    assert any("samples/generated" in l.get("text", {}) for l in lines)
+    from perceiver_io_tpu.observability import read_metrics_jsonl
+
+    rows = read_metrics_jsonl(str(tmp_path / "metrics.jsonl"))
+    assert any("samples/generated" in r["text"] for r in rows)
 
 
 @pytest.mark.slow

@@ -94,7 +94,10 @@ def test_cached_executor_fifo_eviction_counts():
     assert "a" not in cache and set(cache) == {"b", "c"}  # FIFO: oldest out
     assert cached_executor(cache, "b", lambda: "rebuilt", max_entries=2) == "built-b"
     delta = {k: executor_cache_stats()[k] - before[k] for k in before}
-    assert delta == {"hits": 1, "misses": 3, "evictions": 1}
+    # legacy short keys and canonical registry names move in lockstep
+    assert delta["hits"] == delta["executor_cache_hits_total"] == 1
+    assert delta["misses"] == delta["executor_cache_misses_total"] == 3
+    assert delta["evictions"] == delta["executor_cache_evictions_total"] == 1
 
 
 # -- scheduler: the mixed-length acceptance workload ----------------------
