@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos obs cov bench dryrun lint
+.PHONY: test test-fast chaos obs cov bench serve-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -24,6 +24,22 @@ cov:
 
 bench:
 	$(PY) bench.py
+
+# slots-vs-bucket serving A/B at the CPU-fallback shape (docs/serving.md):
+# mixed prompt lengths + heterogeneous max_new_tokens through both engines,
+# printing the tokens/s ratio, slot occupancy, and padding-waste split
+serve-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	from perceiver_io_tpu.inference import cast_float_params; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = cast_float_params(model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params'], jnp.bfloat16); \
+	print(json.dumps({'serve_ab': bench._bench_serve_ab(model, params, cfg)}, indent=2))"
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -503,11 +503,20 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
         if left() > 120.0:
             log("run: serving probe (shape-bucketed micro-batching)")
             try:
-                srv = _bench_serve(model, state.params, cfg)
+                # the slots-vs-bucket A/B runs ~2 min at the CPU shape;
+                # skip it when the remaining budget couldn't also fit the
+                # chaos + observability probes
+                srv = _bench_serve(model, state.params, cfg, with_ab=left() > 300.0)
                 res.update(extras={**res.data["extras"], "serve": srv})
                 log(f"run: serve {srv['tokens_per_sec']} tok/s, "
                     f"{srv['compile_count']} compiles for "
                     f"{srv['distinct_prompt_lens']} distinct prompt lengths")
+                ab = srv.get("slots_vs_bucket", {})
+                if ab:
+                    log(f"run: serve A/B slots {ab['slots']['tokens_per_sec']} "
+                        f"vs bucket {ab['bucket']['tokens_per_sec']} tok/s "
+                        f"(speedup {ab['slots_vs_bucket_speedup']}x, slot "
+                        f"occupancy {ab['slots']['slot_occupancy']})")
             except Exception as e:
                 log(f"run: serving probe failed ({type(e).__name__}: {e})")
                 res.update(extras={**res.data["extras"], "serve": {
@@ -680,7 +689,8 @@ def _bench_decode(model, params, cfg):
     return out
 
 
-def _bench_serve(model, params, cfg, *, n_requests: int = 24, new_tokens: int = 8):
+def _bench_serve(model, params, cfg, *, n_requests: int = 24, new_tokens: int = 8,
+                 with_ab: bool = True):
     """Mixed-length serving probe: a ragged prompt distribution (>= 8
     distinct lengths when the context allows) through the shape-bucketed
     ``ServingEngine`` (docs/serving.md). Two passes over the same traffic:
@@ -722,7 +732,7 @@ def _bench_serve(model, params, cfg, *, n_requests: int = 24, new_tokens: int = 
     _fetch(outs[-1][-1])
     dt = time.perf_counter() - t0
     stats = engine.stats()
-    return {
+    out = {
         "tokens_per_sec": round(n_requests * new_tokens / dt, 1),
         "compile_count": compile_count,
         "steady_state_compiles": stats["compiles"],
@@ -734,6 +744,131 @@ def _bench_serve(model, params, cfg, *, n_requests: int = 24, new_tokens: int = 
         "distinct_prompt_lens": int(len(set(int(n) for n in prompt_lens))),
         "bucket_grid": stats["bucket_grid"],
         "prompt_padding_efficiency": stats["prompt_padding_efficiency"],
+    }
+    if with_ab:  # the tier-1 probe test skips this (suite-budget control)
+        out["slots_vs_bucket"] = _bench_serve_ab(model, params, cfg)
+    return out
+
+
+def _bench_serve_ab(model, params, cfg, *, n_requests: int = 16, slots: int = 8):
+    """Slots-vs-bucket A/B on the workload that exposes generation-granular
+    batching's two wastes (ISSUE 4 / the ragged-batch TPU-serving papers):
+    ragged prompt lengths AND heterogeneous ``max_new_tokens``. The bucket
+    engine can only pack identical-config requests, so mixed decode lengths
+    fragment into underfilled micro-batches padded to the batch bucket —
+    filler rows burn real decode compute. The slot engine's persistent
+    ``S``-slot decode state retires each row the token it finishes and
+    refills the freed slot from the queue mid-generation, so its padded-row
+    fraction is just the drain tail.
+
+    The primary comparison fixes BOTH engines to one resident batch shape
+    (``batch_sizes=(slots,)``) — the TPU-serving configuration the papers
+    target, where the hardware runs one compiled decode shape and filler
+    rows cost real compute (this CPU probe prices filler rows linearly,
+    standing in for the TPU's fixed-shape executor). Because an operator
+    COULD instead give the bucket engine a full batch grid and let small
+    batches pack exactly, the record also carries a ``bucket_exact``
+    variant (grid ``1,2,4,...,slots``, 4x the executor count) so the
+    scheduling-granularity and table effects are separable. All engines
+    run the identical request list after a compile pass; tokens/s counts
+    USEFUL tokens (sum of each request's own ``max_new_tokens``).
+    ``params`` arrive bf16-cast from :func:`_bench_serve`; shapes derive
+    from ``cfg``, so the probe is CPU-runnable at the reduced fallback
+    shape."""
+    import dataclasses
+
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.serving import BucketTable, ServingEngine, SlotServingEngine
+
+    n = cfg.max_seq_len
+    num_latents = min(4, cfg.max_latents)
+    max_len = min(64, n // 2, cfg.max_seq_len - cfg.max_latents + num_latents)
+    # decode-length pool: ~8 distinct values (real traffic rarely shares a
+    # max_new_tokens, and the bucket engine can only pack identical-config
+    # requests), capped so the probe stays seconds-scale on CPU and every
+    # request fits the slot window
+    cap = min(n - max_len, 32)
+    pool = tuple(sorted({max(1, cap * f // 32) for f in (2, 3, 4, 6, 8, 12, 16, 32)}))
+    base = GenerationConfig(max_new_tokens=pool[-1], num_latents=num_latents)
+    cfgs = [
+        dataclasses.replace(base, max_new_tokens=pool[i % len(pool)])
+        for i in range(n_requests)
+    ]
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(num_latents, max_len + 1, size=n_requests)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=int(s), dtype=np.int32) for s in sizes
+    ]
+    useful_tokens = sum(c.max_new_tokens for c in cfgs)
+    grid = tuple(sorted({max(num_latents, max_len // 2), max_len}))
+
+    def run(make_engine):
+        compile_engine = make_engine()
+        for p, c in zip(prompts, cfgs):
+            compile_engine.submit(p, config=c)
+        compile_engine.run_until_idle()
+        engine = make_engine()
+        t0 = time.perf_counter()
+        for p, c in zip(prompts, cfgs):
+            engine.submit(p, config=c)
+        engine.run_until_idle()
+        dt = time.perf_counter() - t0
+        return engine, dt
+
+    def row_waste(engine):
+        counts = engine.registry.counters()
+        return round(
+            counts.get("serving_decode_rows_padded_total", 0.0)
+            / max(1.0, counts.get("serving_decode_rows_total", 0.0)), 4,
+        )
+
+    table = BucketTable(prompt_lens=grid, batch_sizes=(slots,))
+    exact_sizes = tuple(sorted({2 ** i for i in range(slots.bit_length())} | {slots}))
+    table_exact = BucketTable(prompt_lens=grid, batch_sizes=exact_sizes)
+    bucket_engine, bucket_dt = run(
+        lambda: ServingEngine(model, params, base, table)
+    )
+    bucket_exact_engine, bucket_exact_dt = run(
+        lambda: ServingEngine(model, params, base, table_exact)
+    )
+    slot_engine, slot_dt = run(
+        lambda: SlotServingEngine(model, params, base, table, slots=slots)
+    )
+    slot_stats = slot_engine.stats()
+    bucket_tps = useful_tokens / bucket_dt
+    bucket_exact_tps = useful_tokens / bucket_exact_dt
+    slot_tps = useful_tokens / slot_dt
+    return {
+        "workload": {
+            "requests": n_requests,
+            "useful_tokens": useful_tokens,
+            "max_new_pool": list(pool),
+            "distinct_prompt_lens": int(len(set(int(s) for s in sizes))),
+            "slots": slots,
+        },
+        "bucket": {
+            "tokens_per_sec": round(bucket_tps, 1),
+            "batches": bucket_engine.stats()["batches"],
+            "decode_rows_padding_waste": row_waste(bucket_engine),
+        },
+        "bucket_exact": {
+            "tokens_per_sec": round(bucket_exact_tps, 1),
+            "batches": bucket_exact_engine.stats()["batches"],
+            "decode_rows_padding_waste": row_waste(bucket_exact_engine),
+            "batch_sizes": list(exact_sizes),
+        },
+        "slots": {
+            "tokens_per_sec": round(slot_tps, 1),
+            "decode_steps": slot_stats["decode_steps"],
+            "prefills": slot_stats["prefills"],
+            "slot_occupancy": slot_stats["slot_occupancy"],
+            "decode_rows_padding_waste": slot_stats["decode_rows_padding_waste"],
+            "p50_decode_step_ms": slot_stats["decode_step_ms"]["p50"],
+        },
+        "slots_vs_bucket_speedup": round(slot_tps / bucket_tps, 2),
+        "slots_vs_bucket_exact_speedup": round(slot_tps / bucket_exact_tps, 2),
     }
 
 

@@ -100,12 +100,12 @@ def _beam_executor(
     as ``generate._generation_executor`` — the eager body re-traced the
     whole scan on every call)."""
     from perceiver_io_tpu.inference.generate import cached_executor, model_fingerprint
-    from perceiver_io_tpu.models.core.modules import fused_qkv_enabled
+    from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
 
     key = (
         type(model).__qualname__, model_fingerprint(model), config,
         b, prompt_len, num_latents, num_beams, length_penalty, ids_dtype,
-        fused_qkv_enabled(),
+        trace_env_fingerprint(),
     )
     return cached_executor(
         _EXECUTOR_CACHE, key,

@@ -279,6 +279,75 @@ def _decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray, m: j
     return logits, cache, length + 1, m + 1
 
 
+def _slot_decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray, m: jnp.ndarray):
+    """Per-row variant of :func:`_decode_step` for the slot serving engine
+    (``serving/slots.py``): ``m`` is a ``(b,)`` vector, not a scalar, because
+    persistent slots are admitted at different times and therefore sit at
+    different latent counts. The stack-cache append and the stack future
+    mask become per-row scatters; every other op is already per-row. For a
+    row whose ``m`` equals the batch scalar, the math is identical to
+    :func:`_decode_step` — that is the slot engine's token-parity claim.
+
+    Write indices are clamped (``min(length, N-1)``, ``min(m, I-1)``) so
+    retired/idle slots whose counters have saturated stay in-bounds; active
+    rows never hit the clamp (the engine rejects requests that would
+    overrun the window).
+
+    :param token: ``(b,)`` the token just appended.
+    :param length: ``(b,)`` real-token count before the append.
+    :param m: ``(b,)`` per-row latent count before the append.
+    :return: (next-token logits, cache, length + 1, m + 1).
+    """
+    ar = mdl.perceiver_ar
+    b = token.shape[0]
+    n = cache["cross_k"].shape[2]
+    num_latents = mdl.max_latents
+
+    wl = jnp.minimum(length, n - 1)  # write index; no-op clamp for active rows
+    p_new = wl[:, None]  # (b, 1) token index of the new position
+    emb, frq = ar.input_adapter(token[:, None], abs_pos=p_new)
+    rot = RotaryEmbedding(frq)
+
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    x_q = ca.q_norm(emb)  # the new token is a latent: q_norm on both sides
+    q = mha.project_q(x_q, rot)
+    k_new, v_new = mha.project_kv(x_q, rot)
+    rows = jnp.arange(b)
+    cross_k = cache["cross_k"].at[rows, :, wl].set(k_new[:, :, 0])
+    cross_v = cache["cross_v"].at[rows, :, wl].set(v_new[:, :, 0])
+    future = jnp.arange(n)[None, :] > length[:, None]  # True = not yet written
+    attn = mha.attend(q, cross_k, cross_v, pad_mask=future, deterministic=True)
+    x = attn + emb
+    x = layer.mlp(x) + x
+
+    wm = jnp.minimum(m, num_latents - 1)
+    stack_k, stack_v = [], []
+    stack_future = jnp.arange(num_latents)[None, :] > m[:, None]
+    for i, sa_layer in enumerate(ar.self_attention.layers):
+        sa = sa_layer.self_attn
+        r = rot if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        k_i = cache["stack_k"][i].at[rows, :, wm].set(k_s[:, :, 0])
+        v_i = cache["stack_v"][i].at[rows, :, wm].set(v_s[:, :, 0])
+        stack_k.append(k_i)
+        stack_v.append(v_i)
+        attn = sa.attention.attend(q_s, k_i, v_i, pad_mask=stack_future, deterministic=True)
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+
+    x_last = x[:, 0]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    cache = {"cross_k": cross_k, "cross_v": cross_v,
+             "stack_k": stack_k, "stack_v": stack_v}
+    return logits, cache, length + 1, m + 1
+
+
 def _decode_step_boundary(
     mdl, window: jnp.ndarray, pad_count: jnp.ndarray, cross_k, cross_v, length
 ):
@@ -506,6 +575,19 @@ def executor_cache_stats() -> dict:
     return out
 
 
+#: extra executor caches (e.g. the slot engine's, ``serving/slots.py``)
+#: registered so :func:`reset_executor_caches` clears them too without a
+#: static import cycle (serving imports this module, not vice versa)
+_EXTRA_CACHES: list = []
+
+
+def register_executor_cache(cache: dict) -> dict:
+    """Register an executor cache dict for :func:`reset_executor_caches`;
+    returns it for inline use at module scope."""
+    _EXTRA_CACHES.append(cache)
+    return cache
+
+
 def reset_executor_caches() -> None:
     """Drop every cached executor and zero the counters (test isolation and
     serving-warmup measurement hook). Rewinding the global counters makes
@@ -517,6 +599,8 @@ def reset_executor_caches() -> None:
 
     _EXECUTOR_CACHE.clear()
     beam._EXECUTOR_CACHE.clear()
+    for cache in _EXTRA_CACHES:
+        cache.clear()
     default_registry().reset("executor_cache_")
 
 
@@ -552,14 +636,16 @@ def _generation_executor(
     ~2 ms/token of actual compute at test scale); this cache makes repeated
     pipeline calls with the same shape/config dispatch a compiled program.
     Keyed by the module's fingerprint, the frozen :class:`GenerationConfig`,
-    shapes, the phase plan, and the trace-time PERCEIVER_FUSED_QKV flag (a
+    shapes, the phase plan, and every trace-time env knob
+    (``PERCEIVER_FUSED_QKV`` and the ``PERCEIVER_FLASH_*`` flags, via
+    :func:`~perceiver_io_tpu.models.core.modules.trace_env_fingerprint`) — a
     mid-process toggle must rebuild the executor, not silently reuse a trace
-    captured under the other setting)."""
-    from perceiver_io_tpu.models.core.modules import fused_qkv_enabled
+    captured under the other setting."""
+    from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
 
     key = (
         type(model).__qualname__, model_fingerprint(model), config,
-        b, prompt_len, num_latents, s1, s2, ids_dtype, fused_qkv_enabled(),
+        b, prompt_len, num_latents, s1, s2, ids_dtype, trace_env_fingerprint(),
     )
     return cached_executor(
         _EXECUTOR_CACHE, key,

@@ -57,14 +57,47 @@ def fused_qkv_enabled() -> bool:
     k/v (cross-attention) projections into single wider matmuls. Like the
     ``PERCEIVER_FLASH_*`` knobs this is read at trace time, so a toggle only
     affects traces captured afterwards (the tuning sweep isolates each
-    setting in a subprocess). The generation/beam executor caches fold the
-    flag into their cache keys (``generate._generation_executor``), so a
-    mid-process toggle rebuilds those executors instead of silently serving
-    a program traced under the other setting. Default off until measured on
-    hardware; exactness vs the unfused path is tested either way."""
+    setting in a subprocess). The generation/beam/slot executor caches fold
+    every trace-time knob into their cache keys
+    (:func:`trace_env_fingerprint`), so a mid-process toggle rebuilds those
+    executors instead of silently serving a program traced under the other
+    setting. Default off until measured on hardware; exactness vs the
+    unfused path is tested either way."""
     import os
 
     return os.environ.get("PERCEIVER_FUSED_QKV", "0") == "1"
+
+
+def trace_env_fingerprint() -> tuple:
+    """Every trace-time env knob that changes the compiled program, as one
+    hashable tuple for executor cache keys (``generate._generation_executor``,
+    ``beam._beam_executor``, ``serving.slots``). Folding ALL of them in —
+    not just ``PERCEIVER_FUSED_QKV`` — means a mid-process toggle of a
+    flash knob rebuilds the executor instead of silently no-op'ing
+    (ADVICE r5 on the process-start-only footgun). Values are normalized to
+    what the consumers parse (``attention._flash_eligible``,
+    ``flash_attention._candidates`` — without importing the pallas module,
+    which only loads on TPU), so semantically identical settings (unset vs
+    ``"0"``, an unparseable override vs the default) share one key instead
+    of retracing. Plain ``jax.jit`` call sites (train steps) still read
+    these at trace time only; the tuning sweep's subprocess isolation
+    remains the contract there."""
+    import os
+
+    try:
+        min_kv = int(os.environ.get("PERCEIVER_FLASH_MIN_KV", "0"))
+    except ValueError:
+        min_kv = 0
+    raw = os.environ.get("PERCEIVER_FLASH_BLOCKS", "")
+    try:
+        blocks = tuple(int(x) for x in raw.split(",")) if raw else ()
+    except ValueError:
+        blocks = ()
+    if not (blocks and all(b > 0 and b % 128 == 0 for b in blocks)):
+        # mirror flash_attention._candidates' validation (LANES == 128):
+        # overrides it would ignore must fingerprint like the unset default
+        blocks = ()
+    return (fused_qkv_enabled(), min_kv, blocks)
 
 
 def _remat_policy(offload: bool):

@@ -144,12 +144,13 @@ def _flash_eligible(q, k, v, dropout_rate) -> bool:
     # per-block overhead can dominate — lets short self-attention use XLA
     # while long-kv cross-attention stays flash. Default 0 = flash everywhere.
     #
-    # PROCESS-START-ONLY: this (and PERCEIVER_FLASH_BLOCKS in
-    # flash_attention.py) is read at trace time and is NOT part of the jit
-    # cache key — changing it in-process after a shape has compiled silently
-    # has no effect. Set it before the first forward pass; the tuning sweep
-    # (examples/perf/tune_step.py) isolates each setting in a subprocess for
-    # exactly this reason.
+    # TRACE-TIME: this (and PERCEIVER_FLASH_BLOCKS in flash_attention.py) is
+    # read at trace time. The inference executor caches (generation, beam,
+    # slot serving) fold it into their cache keys via
+    # ``modules.trace_env_fingerprint``, so a mid-process toggle rebuilds
+    # those executors; plain ``jax.jit`` call sites (train steps) are NOT
+    # keyed on it — set it before the first forward pass there, or isolate
+    # per-setting in a subprocess as examples/perf/tune_step.py does.
     import os
 
     try:

@@ -162,9 +162,16 @@ class ServeArgs:
     max_new_tokens: int = 64
     num_latents: int = 1
     temperature: float = 0.0  # greedy by default — deterministic serving
+    #: scheduler: ``bucket`` packs whole micro-batches per compiled
+    #: generation; ``slots`` is token-granular continuous batching over a
+    #: persistent multi-slot decode state (docs/serving.md — prefer it for
+    #: mixed traffic; it requires prompt_len + max_new_tokens <= context)
+    engine: str = "bucket"
+    #: persistent decode slots for ``--serve.engine=slots``
+    slots: int = 8
     #: prompt-length bucket grid; default = powers of two up to the context
     prompt_buckets: Optional[typing.Tuple[int, ...]] = None
-    #: micro-batch size grid
+    #: micro-batch size grid (``bucket`` engine; ignored by ``slots``)
     batch_buckets: typing.Tuple[int, ...] = (1, 2, 4, 8)
     #: compile every bucket before accepting traffic
     warmup: bool = True
@@ -537,7 +544,12 @@ class CLI:
         from perceiver_io_tpu.inference.samplers import SamplingConfig
         from perceiver_io_tpu.models import model_for_config
         from perceiver_io_tpu.observability import ObservabilityArgs, Tracer
-        from perceiver_io_tpu.serving import BucketTable, QueueFull, ServingEngine
+        from perceiver_io_tpu.serving import (
+            BucketTable,
+            QueueFull,
+            ServingEngine,
+            SlotServingEngine,
+        )
         from perceiver_io_tpu.training.checkpoint import load_pretrained
 
         ckpt = values.get("ckpt") or values.get("params")
@@ -586,14 +598,23 @@ class CLI:
             eos_token_id=tok.eos_token_id,
             sampling=SamplingConfig(temperature=args.temperature),
         )
-        engine = ServingEngine(
-            model, params, gen_cfg, table,
+        if args.engine not in ("bucket", "slots"):
+            raise SystemExit(
+                f"--serve.engine must be 'bucket' or 'slots', got {args.engine!r}"
+            )
+        engine_kwargs = dict(
             rng=jax.random.PRNGKey(args.seed),
             max_queue=args.max_queue,
             default_deadline_s=args.deadline_s,
             registry=kit["registry"],
             tracer=tracer,
         )
+        if args.engine == "slots":
+            engine = SlotServingEngine(
+                model, params, gen_cfg, table, slots=args.slots, **engine_kwargs
+            )
+        else:
+            engine = ServingEngine(model, params, gen_cfg, table, **engine_kwargs)
         if args.warmup:
             t0 = time.monotonic()
             compiles = engine.warmup()
@@ -634,10 +655,10 @@ class CLI:
             ids = np.asarray(tok.encode(p), np.int32)
             try:
                 # backpressure: make room BEFORE submitting so a full queue
-                # drains a micro-batch instead of tripping the shed counter
-                # (shed should count true rejections, not this retry loop)
-                while not engine.health()["ready"] and engine.step():
-                    pass
+                # drains work instead of tripping the shed counter (shed
+                # should count true rejections, not this retry loop)
+                while not engine.health()["ready"] and engine.pending():
+                    engine.step()
                 req = engine.submit(ids)
                 handles.append((p, req, None, req.trace_id, None))
             except (ValueError, QueueFull) as e:
@@ -654,8 +675,11 @@ class CLI:
                 kit["snapshot_writer"].maybe_write()
         # CLI-driven drain (not the blocking engine.drain()): the snapshot
         # cadence must keep firing while the queue — the bulk of the run's
-        # wall time — generates, or a mid-run poller sees stale telemetry
-        while engine.step():
+        # wall time — generates, or a mid-run poller sees stale telemetry.
+        # pending(), not step()'s return value: a slot-engine step advances
+        # one token and legitimately disposes of nothing mid-generation.
+        while engine.pending():
+            engine.step()
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write()
         engine.drain()  # queue already empty: just stop accepting
@@ -691,6 +715,7 @@ class CLI:
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --obs.* --config=<yaml> --data=<name> --ckpt=<dir>")
         print("serve: --ckpt=<dir> --serve.prompts=<file|stdin> --serve.max_new_tokens "
+              "--serve.engine={bucket|slots} --serve.slots "
               "--serve.prompt_buckets --serve.batch_buckets --serve.warmup "
               "--serve.max_queue --serve.deadline_s")
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "

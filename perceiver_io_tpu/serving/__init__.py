@@ -1,14 +1,27 @@
-"""Serving layer — shape bucketing + continuous micro-batching over the
-compiled generation executors (docs/serving.md). The first load-path layer
-between "a jitted ``generate()``" and "a service": ragged traffic lands on
-a small pre-compilable executor grid instead of retracing per exact shape.
+"""Serving layer (docs/serving.md): two engines over the compiled
+generation executors.
 
-Hardened for load (docs/reliability.md): bounded queue with
+- :class:`ServingEngine` — shape bucketing + continuous micro-batching at
+  *generation* granularity: ragged traffic lands on a small pre-compilable
+  executor grid instead of retracing per exact shape.
+- :class:`SlotServingEngine` — token-granular continuous batching over a
+  persistent fixed-shape multi-slot decode state: per-token scheduling,
+  immediate EOS/deadline retirement, mid-generation slot refill, one
+  decode executor for all traffic.
+
+Both are hardened for load (docs/reliability.md): bounded queue with
 :class:`QueueFull` backpressure, per-request deadlines, per-request error
 isolation, graceful ``drain()``, and a ``health()`` readiness snapshot.
 """
 from perceiver_io_tpu.reliability import QueueFull
 from perceiver_io_tpu.serving.buckets import BucketTable
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine
+from perceiver_io_tpu.serving.slots import SlotServingEngine
 
-__all__ = ["BucketTable", "QueueFull", "ServeRequest", "ServingEngine"]
+__all__ = [
+    "BucketTable",
+    "QueueFull",
+    "ServeRequest",
+    "ServingEngine",
+    "SlotServingEngine",
+]

@@ -185,6 +185,8 @@ class ServingEngine:
             *STAT_ALIASES,
             "serving_prompt_tokens_real_total",
             "serving_prompt_tokens_padded_total",
+            "serving_decode_rows_total",
+            "serving_decode_rows_padded_total",
         )
         self.tracer = tracer
 
@@ -275,6 +277,14 @@ class ServingEngine:
             )
         return [r.result for r in reqs]
 
+    def pending(self) -> bool:
+        """True while a call to :meth:`step` has work to do. The bucket
+        engine's unit of work is a whole micro-batch, so this is just queue
+        depth; the slot engine overrides it to include resident slots (its
+        ``step`` legitimately disposes of nothing mid-generation). Drive
+        drain loops off this, not off ``step()``'s return value."""
+        return bool(self._queue)
+
     def run_until_idle(self) -> int:
         """Drain the whole queue; returns the number of requests disposed of
         (completed + timed out + failed)."""
@@ -320,6 +330,35 @@ class ServingEngine:
             self.tracer.end_span(
                 span, status=status, **({"error": error} if error else {})
             )
+
+    def _apply_request_chaos(self, req: ServeRequest) -> bool:
+        """Run the per-request chaos hook (``serving.request``); returns True
+        when the fault disposed of the request — an injected error fails it,
+        a hang advances the injectable clock and times it out if that burned
+        through its deadline. Shared by both engines' schedulers so fault
+        semantics cannot drift between them."""
+        if self._chaos is None:
+            return False
+        fault = self._chaos.hit("serving.request", req.request_id)
+        if fault is None:
+            return False
+        if fault.kind == "error":
+            self._finish(req, "failed", error=str(fault.make_error()))
+            return True
+        if fault.kind == "hang":
+            # A hung request stalls its slot: advance the injectable clock
+            # (FakeClock; a real monotonic clock can't be moved) and re-check
+            # the deadline it just burned through.
+            advance = getattr(self._clock, "advance", None)
+            if advance is not None:
+                advance(fault.delay_s)
+            if req.deadline_at is not None and self._clock() >= req.deadline_at:
+                self._finish(
+                    req, "timed_out",
+                    error=f"hung for {fault.delay_s}s past its deadline",
+                )
+                return True
+        return False
 
     def _expire_overdue(self) -> int:
         """Complete every queue entry past its deadline as ``timed_out`` so
@@ -381,25 +420,9 @@ class ServingEngine:
             if len(picked) >= self.table.batch_sizes[-1] or req.config != cfg:
                 rest.append(req)
                 continue
-            fault = self._chaos.hit("serving.request", req.request_id) if self._chaos else None
-            if fault is not None and fault.kind == "error":
-                self._finish(req, "failed", error=str(fault.make_error()))
+            if self._apply_request_chaos(req):
                 disposed += 1
                 continue
-            if fault is not None and fault.kind == "hang":
-                # A hung request stalls its slot: advance the injectable
-                # clock (FakeClock; a real monotonic clock can't be moved)
-                # and re-check the deadline it just burned through.
-                advance = getattr(self._clock, "advance", None)
-                if advance is not None:
-                    advance(fault.delay_s)
-                if req.deadline_at is not None and self._clock() >= req.deadline_at:
-                    self._finish(
-                        req, "timed_out",
-                        error=f"hung for {fault.delay_s}s past its deadline",
-                    )
-                    disposed += 1
-                    continue
             picked.append(req)
         self._queue = rest
         if not picked:
@@ -477,6 +500,14 @@ class ServingEngine:
             sum(int(r.prompt.size) for r in picked),
         )
         self.registry.inc("serving_prompt_tokens_padded_total", b * length)
+        # decode-row accounting, comparable with the slot engine's: every
+        # row of every decode step, split real vs batch-padding filler —
+        # the padding-waste ratio the serve bench A/B reports
+        self.registry.inc("serving_decode_rows_total", b * cfg.max_new_tokens)
+        self.registry.inc(
+            "serving_decode_rows_padded_total",
+            (b - len(picked)) * cfg.max_new_tokens,
+        )
         return disposed + len(picked)
 
     # -- ahead-of-time warmup ----------------------------------------------

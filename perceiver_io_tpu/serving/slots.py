@@ -1,0 +1,583 @@
+"""Token-granular continuous batching: the persistent-slot decode engine.
+
+The bucket engine (``serving/engine.py``) schedules at *generation*
+granularity: a micro-batch is packed, a whole compiled ``generate()`` runs
+to completion, and only then can queued requests join — a newly arrived
+prompt waits a full batch of decoding, and a row that hits EOS early burns
+its slot until the slowest row finishes. Both "Ragged Paged Attention"
+(TPU serving kernels over ragged in-flight batches) and the compiler-first
+O(1)-caching paper (PAPERS.md) land on the same fix: keep a **fixed-shape
+resident decode state** and make scheduling **per token**.
+
+This module is that engine. Serving splits into two compiled phases:
+
+- **Prefill** — one executor per *prompt bucket* ``L``: right-align the
+  prompt into the full decode window, run
+  :func:`~perceiver_io_tpu.inference.generate._decode_prefill` at batch 1,
+  and ``dynamic_update_slice`` the resulting KV caches + row state into
+  slot ``s`` of the persistent multi-slot state. ``s`` is a traced scalar,
+  so admitting into any slot reuses one program.
+- **Decode** — exactly ONE fixed-shape executor advances all ``S`` slots by
+  one token per call, using the per-row ``length``/``m`` vectors
+  (:func:`~perceiver_io_tpu.inference.generate._slot_decode_step`) for
+  ragged masking. No bucket grid on the decode path, no retracing as
+  traffic mixes. When any active slot has filled its latent segment
+  (``m == max_latents``), the engine switches to the **boundary variant**:
+  a second executor that computes both the latent-growth step and the
+  boundary-migration step (:func:`..generate._decode_step_boundary`) and
+  selects per row — correct for mixed phases at ~2x step cost, used only
+  while a boundary-phase row is resident.
+
+``step()`` is a token-level scheduler: it retires slots immediately on
+EOS / ``max_new_tokens`` / deadline expiry, refills freed slots
+mid-generation by prefilling the next queued request into them, and keeps
+the per-request trace alive across the slot lifecycle
+(``serving.slot_assigned`` / ``serving.slot_retired`` events on the
+request's trace; docs/observability.md).
+
+Compile-count guarantee: at most ``len(prompt_buckets)`` prefill executors
+plus one decode executor plus its boundary variant — mixed-length traffic
+causes **zero** additional retraces after :meth:`SlotServingEngine.warmup`
+(pinned by ``tests/test_slots.py``).
+
+Exactness: for greedy decoding the slot engine is token-identical to
+unbucketed per-request ``generate()``, including requests admitted into
+recycled slots mid-generation — each row's dynamic phase schedule (latent
+growth while ``m < max_latents``, then boundary migration) reproduces the
+static per-request plan exactly. Two scope restrictions keep that true,
+enforced with precise errors at ``submit``:
+
+- ``prompt_len + max_new_tokens <= max_seq_len`` — the sliding-window
+  phase (semantically forced recompute, ``generate`` module docstring) has
+  no incremental slot form; route longer generations to the bucket engine.
+- ``prompt_len >= min(bucket_len, num_latents)`` — left pads must never
+  occupy latent slots (the boundary cache's validity precondition; the
+  bucket engine serves such prompts via its windowed-recompute demotion).
+
+Fault tolerance mirrors the bucket engine (docs/reliability.md): bounded
+queue backpressure, per-request deadlines checked every token (expiry
+mid-generation retires the slot and ends the request's one terminal span
+``timed_out``), per-request chaos hooks at admit time, executor-level
+faults failing only resident requests while the queue survives.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.inference.generate import (
+    GenerationConfig,
+    _decode_prefill,
+    _decode_step_boundary,
+    _slot_decode_step,
+    cached_executor,
+    executor_cache_stats,
+    model_fingerprint,
+    register_executor_cache,
+)
+from perceiver_io_tpu.inference.samplers import apply_min_new_tokens, sample_logits
+from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine, _round_ms
+
+_EXECUTOR_CACHE: dict = register_executor_cache({})
+
+
+def _donate(*argnums: int) -> tuple:
+    """Donate the persistent slot state into the executor (in-place cache
+    update on device) — skipped on CPU, where donation is unimplemented and
+    only produces a warning per compile."""
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
+_STATE_SHAPES: dict = {}  # (model key, param dtypes) -> (logits, cache) shapes
+
+
+def _prefill_shapes(model, params):
+    """ShapeDtypeStructs of one row's prefill outputs, via an abstract eval
+    (no compile, no FLOPs). Tracing the flax module still costs hundreds of
+    ms, so the result is memoized per (architecture, param dtypes) — engine
+    construction and post-fault state rebuilds stay cheap."""
+    key = (
+        type(model).__qualname__, model_fingerprint(model),
+        tuple(sorted({str(l.dtype) for l in jax.tree_util.tree_leaves(params)})),
+    )
+    hit = _STATE_SHAPES.get(key)
+    if hit is not None:
+        return hit
+    n = model.max_seq_len
+
+    def fn(p):
+        window = jnp.zeros((1, n), jnp.int32)
+        pad = jnp.zeros((1,), jnp.int32)
+        return model.apply(
+            {"params": p}, window, pad, jnp.asarray(1, jnp.int32),
+            method=_decode_prefill,
+        )
+
+    logits_s, cache_s, _, _ = jax.eval_shape(fn, params)
+    if len(_STATE_SHAPES) > 32:
+        _STATE_SHAPES.clear()
+    _STATE_SHAPES[key] = (logits_s, cache_s)
+    return logits_s, cache_s
+
+
+def _blank_state(model, params, slots: int, pad_token_id: int) -> dict:
+    """Zero-initialized persistent multi-slot decode state; KV-cache and
+    logits shapes/dtypes track the model's computation dtype."""
+    n = model.max_seq_len
+    logits_s, cache_s = _prefill_shapes(model, params)
+
+    def z(sds):
+        return jnp.zeros((slots,) + tuple(sds.shape[1:]), sds.dtype)
+
+    return {
+        "window": jnp.full((slots, n), pad_token_id, jnp.int32),
+        "pad": jnp.full((slots,), n, jnp.int32),
+        "length": jnp.zeros((slots,), jnp.int32),
+        "m": jnp.zeros((slots,), jnp.int32),
+        "steps": jnp.zeros((slots,), jnp.int32),
+        "logits": z(logits_s),
+        "cross_k": z(cache_s["cross_k"]),
+        "cross_v": z(cache_s["cross_v"]),
+        "stack_k": tuple(z(s) for s in cache_s["stack_k"]),
+        "stack_v": tuple(z(s) for s in cache_s["stack_v"]),
+    }
+
+
+def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int):
+    """Prefill one request at prompt bucket ``bucket_len`` and insert its
+    caches + row state into slot ``slot`` of the persistent state."""
+    n = model.max_seq_len
+    m0 = min(bucket_len, config.num_latents)
+
+    def upd(dst, src, slot):
+        return jax.lax.dynamic_update_slice(
+            dst, src.astype(dst.dtype), (slot,) + (0,) * (dst.ndim - 1)
+        )
+
+    def run(params, ids, pad_count, slot, state):
+        window = jnp.full((1, n), config.pad_token_id, ids.dtype)
+        window = window.at[:, n - bucket_len:].set(ids)
+        pad = pad_count.astype(jnp.int32) + (n - bucket_len)
+        logits, cache, length, _ = model.apply(
+            {"params": params}, window, pad, jnp.asarray(m0, jnp.int32),
+            method=_decode_prefill,
+        )
+        new = dict(state)
+        new["cross_k"] = upd(state["cross_k"], cache["cross_k"], slot)
+        new["cross_v"] = upd(state["cross_v"], cache["cross_v"], slot)
+        new["stack_k"] = tuple(
+            upd(d, s, slot) for d, s in zip(state["stack_k"], cache["stack_k"])
+        )
+        new["stack_v"] = tuple(
+            upd(d, s, slot) for d, s in zip(state["stack_v"], cache["stack_v"])
+        )
+        new["window"] = upd(state["window"], window, slot)
+        new["pad"] = upd(state["pad"], pad, slot)
+        new["length"] = upd(state["length"], length.astype(jnp.int32), slot)
+        new["m"] = upd(state["m"], jnp.full((1,), m0, jnp.int32), slot)
+        new["steps"] = upd(state["steps"], jnp.zeros((1,), jnp.int32), slot)
+        new["logits"] = upd(state["logits"], logits, slot)
+        return new
+
+    return jax.jit(run, donate_argnums=_donate(4))
+
+
+def _build_decode_executor(model, config: GenerationConfig, boundary: bool):
+    """One fixed-shape token step over all slots: sample each row's next
+    token from the resident logits, append it, advance every cache by one
+    token. ``boundary=True`` additionally runs the boundary-migration step
+    and selects per row (``m == max_latents``) — the conservative mixed-
+    phase variant, compiled once and used only while such a row is
+    resident."""
+    n = model.max_seq_len
+    max_latents = model.max_latents
+    min_new = config.min_new_tokens if config.eos_token_id is not None else 0
+
+    def run(params, state, rng):
+        logits = state["logits"].astype(jnp.float32)
+        # EOS unreachable until min_new_tokens — per-row step counts (the
+        # scan path passes a scalar step; broadcasting handles the vector)
+        logits = apply_min_new_tokens(
+            logits, state["steps"][:, None], min_new, config.eos_token_id or 0
+        )
+        pad_positions = jnp.arange(n)[None, :] < state["pad"][:, None]
+        token = sample_logits(
+            rng, logits, config.sampling, state["window"], pad_positions
+        )
+        window = jnp.concatenate(
+            [state["window"][:, 1:], token[:, None].astype(state["window"].dtype)],
+            axis=1,
+        )
+        pad = jnp.maximum(state["pad"] - 1, 0)
+        length, m = state["length"], state["m"]
+        cache = {
+            "cross_k": state["cross_k"], "cross_v": state["cross_v"],
+            "stack_k": list(state["stack_k"]), "stack_v": list(state["stack_v"]),
+        }
+        logits_a, cache_a, _, _ = model.apply(
+            {"params": params}, token, cache, length, m, method=_slot_decode_step
+        )
+        new_logits = logits_a
+        cross_k, cross_v = cache_a["cross_k"], cache_a["cross_v"]
+        stack_k, stack_v = cache_a["stack_k"], cache_a["stack_v"]
+        if boundary:
+            logits_b, ck_b, cv_b, _ = model.apply(
+                {"params": params}, window, pad,
+                state["cross_k"], state["cross_v"], length,
+                method=_decode_step_boundary,
+            )
+            is_b = m >= max_latents
+            r4 = is_b[:, None, None, None]
+            new_logits = jnp.where(is_b[:, None], logits_b, logits_a)
+            cross_k = jnp.where(r4, ck_b, cross_k)
+            cross_v = jnp.where(r4, cv_b, cross_v)
+            # boundary rows' stack caches are stale by construction (the
+            # boundary step recomputes the whole stack); keep their old
+            # entries untouched so latent rows' appends survive the select
+            stack_k = [jnp.where(r4, old, a) for old, a in zip(state["stack_k"], stack_k)]
+            stack_v = [jnp.where(r4, old, a) for old, a in zip(state["stack_v"], stack_v)]
+        new_state = {
+            "window": window,
+            "pad": pad,
+            "length": jnp.minimum(length + 1, n),  # idle slots saturate
+            "m": jnp.minimum(m + 1, max_latents),
+            "steps": state["steps"] + 1,
+            "logits": new_logits.astype(state["logits"].dtype),
+            "cross_k": cross_k, "cross_v": cross_v,
+            "stack_k": tuple(stack_k), "stack_v": tuple(stack_v),
+        }
+        return new_state, token
+
+    return jax.jit(run, donate_argnums=_donate(1))
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side record of one resident request: the emitted tokens plus the
+    mirrored per-row counters the scheduler needs without device reads."""
+
+    req: ServeRequest
+    slot: int
+    max_new: int
+    m: int  # mirrors state["m"][slot] for decode-variant choice
+    emitted: List[int] = dataclasses.field(default_factory=list)
+
+
+class SlotServingEngine(ServingEngine):
+    """Token-granular scheduler over the persistent-slot decode state.
+
+    Shares the bucket engine's whole request surface — ``submit`` /
+    ``serve`` / ``step`` / ``run_until_idle`` / ``drain`` / ``stats`` /
+    ``health``, bounded queue, deadlines, chaos hooks, metrics registry,
+    tracer — but ``step()`` advances ONE TOKEN across all ``S`` slots
+    instead of one whole micro-batch, admitting and retiring in flight.
+
+    :param slots: number of persistent decode slots ``S`` (the decode
+        executor's fixed batch dimension). The bucket table's
+        ``batch_sizes`` are ignored; ``prompt_lens`` are the prefill grid.
+    """
+
+    def __init__(self, model, params, config: Optional[GenerationConfig] = None,
+                 table=None, *, slots: int = 8, **kwargs):
+        super().__init__(model, params, config, table, **kwargs)
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.slots = int(slots)
+        self.registry.declare_counters(
+            "serving_decode_steps_total",
+            "serving_decode_rows_total",
+            "serving_decode_rows_padded_total",
+            "serving_prefills_total",
+        )
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._state = _blank_state(model, params, self.slots, self.config.pad_token_id)
+        self._update_slot_gauges()
+
+    # -- executors -----------------------------------------------------------
+    def _cache_key(self, kind: str, *extra):
+        from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
+
+        # max_new_tokens is scheduled host-side (per-request retirement), so
+        # it must NOT key the executors — requests overriding it share one
+        # compiled program
+        cfg = dataclasses.replace(self.config, max_new_tokens=0)
+        return (
+            kind, type(self.model).__qualname__, model_fingerprint(self.model),
+            cfg, self.slots, trace_env_fingerprint(), *extra,
+        )
+
+    def _prefill_executor(self, bucket_len: int):
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("slot_prefill", bucket_len),
+            lambda: _build_prefill_executor(self.model, self.config, bucket_len),
+        )
+
+    def _decode_executor(self, boundary: bool):
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("slot_decode", boundary),
+            lambda: _build_decode_executor(self.model, self.config, boundary),
+        )
+
+    # -- feasibility ---------------------------------------------------------
+    def _pick_prompt_bucket(self, length: int, cfg: GenerationConfig) -> int:
+        """Bucket choice plus the slot engine's scope checks (module
+        docstring); called from ``submit`` so violations reject with a
+        terminal span, never mid-schedule."""
+        if dataclasses.replace(cfg, max_new_tokens=self.config.max_new_tokens) != self.config:
+            raise ValueError(
+                "slot engine requests must share the engine GenerationConfig "
+                "(only max_new_tokens may differ per request): the decode "
+                "executor is compiled once for one sampling/eos/latent plan"
+            )
+        if cfg.max_new_tokens < 1:
+            # the decode loop always advances at least one token; a 0-token
+            # request would retire with more emitted tokens than its result
+            # can hold
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {cfg.max_new_tokens}"
+            )
+        cap = super()._pick_prompt_bucket(length, cfg)
+        if length + cfg.max_new_tokens > self.model.max_seq_len:
+            raise ValueError(
+                f"prompt length {length} + max_new_tokens "
+                f"{cfg.max_new_tokens} overruns the context "
+                f"{self.model.max_seq_len}: the sliding-window phase has no "
+                "slot form — use the bucket engine for this request"
+            )
+        if length < min(cap, cfg.num_latents):
+            raise ValueError(
+                f"prompt length {length} is shorter than the "
+                f"{min(cap, cfg.num_latents)} latent positions its prompt "
+                f"bucket ({cap}) assigns under num_latents="
+                f"{cfg.num_latents}: left pads would occupy latent slots "
+                "(boundary-cache precondition) — use the bucket engine for "
+                "this request, or configure num_latents at or below the "
+                "shortest served prompt"
+            )
+        return cap
+
+    # -- slot lifecycle ------------------------------------------------------
+    def _update_slot_gauges(self) -> None:
+        active = sum(1 for s in self._slots if s is not None)
+        self.registry.set_gauge("serving_slots_active", active)
+        self.registry.set_gauge("serving_slots_idle", self.slots - active)
+
+    def _active(self) -> List[_Slot]:
+        return [s for s in self._slots if s is not None]
+
+    def pending(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def _admit(self, req: ServeRequest, slot: int) -> None:
+        cfg = req.config
+        bucket_len = self._pick_prompt_bucket(int(req.prompt.size), cfg)
+        ids = np.full((1, bucket_len), cfg.pad_token_id, np.int32)
+        ids[0, bucket_len - req.prompt.size:] = req.prompt
+        pad = np.asarray([bucket_len - req.prompt.size], np.int32)
+        executor = self._prefill_executor(bucket_len)
+        t0 = self._clock()
+        # queue wait ends when the prefill STARTS (the bucket engine's
+        # batch-assembly convention) — prefill time is its own histogram,
+        # not queue wait
+        req.started_at = t0
+        self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
+        self._state = executor(
+            self.params, jnp.asarray(ids), jnp.asarray(pad),
+            np.int32(slot), self._state,
+        )
+        # fetch one (tiny) output leaf: the executor is a single XLA program,
+        # so this fences the whole prefill — without it, async dispatch (TPU)
+        # would record ~0 here and bleed the real prefill cost into the next
+        # decode step's histogram (same sync discipline as the bucket
+        # engine's np.asarray before timing)
+        np.asarray(self._state["length"])
+        prefill_ms = (self._clock() - t0) * 1e3
+        self.registry.observe("serving_prefill_ms", prefill_ms)
+        self.registry.inc("serving_prefills_total")
+        self.registry.inc("serving_prompt_tokens_real_total", int(req.prompt.size))
+        self.registry.inc("serving_prompt_tokens_padded_total", bucket_len)
+        self._slots[slot] = _Slot(
+            req=req, slot=slot, max_new=cfg.max_new_tokens,
+            m=min(bucket_len, cfg.num_latents),
+        )
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.slot_assigned", trace_id=req.trace_id, slot=slot,
+                bucket=bucket_len, prefill_ms=round(prefill_ms, 3),
+            )
+
+    def _retire(self, entry: _Slot, status: str, *, error: Optional[str] = None) -> None:
+        if status == "ok":
+            pad_id = entry.req.config.pad_token_id
+            out = np.full((entry.max_new,), pad_id, np.int32)
+            out[: len(entry.emitted)] = entry.emitted
+            entry.req.result = out
+        self._finish(entry.req, status, error=error)
+        self._slots[entry.slot] = None
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.slot_retired", trace_id=entry.req.trace_id,
+                slot=entry.slot, status=status, decode_steps=len(entry.emitted),
+            )
+
+    def _fail_resident(self, error: str) -> int:
+        """Executor-level fault: every resident request fails, the queue
+        survives, and the (possibly donated-away) device state is rebuilt."""
+        failed = 0
+        for entry in self._active():
+            self._retire(entry, "failed", error=error)
+            failed += 1
+        self._state = _blank_state(
+            self.model, self.params, self.slots, self.config.pad_token_id
+        )
+        self._update_slot_gauges()
+        return failed
+
+    # -- the token-level scheduler ------------------------------------------
+    def step(self) -> int:
+        """Advance serving by ONE TOKEN: expire deadlines (queued and
+        resident), refill free slots from the queue, run one fixed-shape
+        decode step over all slots, and retire rows that just finished
+        (EOS / max_new_tokens). Returns the number of requests disposed of
+        this call; ``pending()`` — not the return value — says whether more
+        work remains (a mid-generation step legitimately disposes of 0).
+        """
+        disposed = self._expire_overdue()
+        now = self._clock()
+        for entry in self._active():
+            req = entry.req
+            if req.deadline_at is not None and now >= req.deadline_at:
+                self._retire(
+                    entry, "timed_out",
+                    error=f"deadline exceeded after {len(entry.emitted)} of "
+                          f"{entry.max_new} tokens",
+                )
+                disposed += 1
+        while self._queue and None in self._slots:
+            req = self._queue.pop(0)
+            if self._apply_request_chaos(req):
+                disposed += 1
+                continue
+            slot = self._slots.index(None)
+            try:
+                self._admit(req, slot)
+            except Exception as e:  # prefill fault: this request + residents
+                self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
+                return disposed + 1 + self._fail_resident(
+                    f"prefill fault poisoned the slot state: {type(e).__name__}: {e}"
+                )
+        self._update_slot_gauges()
+        active = self._active()
+        if not active:
+            return disposed
+
+        boundary = any(s.m >= self.model.max_latents for s in active)
+        self._rng, key = jax.random.split(self._rng)
+        t0 = self._clock()
+        try:
+            fault = self._chaos.hit("serving.batch") if self._chaos else None
+            if fault is not None and fault.kind == "error":
+                raise fault.make_error()
+            executor = self._decode_executor(boundary)
+            self._state, tokens = executor(self.params, self._state, key)
+            tokens = np.asarray(tokens)  # host sync: the scheduling point
+        except Exception as e:
+            self.registry.observe(
+                "serving_decode_step_ms", (self._clock() - t0) * 1e3
+            )
+            return disposed + self._fail_resident(f"{type(e).__name__}: {e}")
+        self.registry.observe("serving_decode_step_ms", (self._clock() - t0) * 1e3)
+        self.registry.inc("serving_decode_steps_total")
+        self.registry.inc("serving_decode_rows_total", self.slots)
+        self.registry.inc("serving_decode_rows_padded_total", self.slots - len(active))
+        self.registry.inc("serving_tokens_generated_total", len(active))
+        eos = self.config.eos_token_id
+        for entry in active:
+            token = int(tokens[entry.slot])
+            entry.emitted.append(token)
+            entry.m = min(entry.m + 1, self.model.max_latents)
+            if (eos is not None and token == eos) or len(entry.emitted) >= entry.max_new:
+                self._retire(entry, "ok")
+                disposed += 1
+        self._update_slot_gauges()
+        return disposed
+
+    def run_until_idle(self) -> int:
+        served = 0
+        while self.pending():
+            served += self.step()
+        return served
+
+    # -- ahead-of-time warmup ------------------------------------------------
+    def warmup(self, config: Optional[GenerationConfig] = None) -> int:
+        """Compile every executor the engine can ever dispatch — one prefill
+        per feasible prompt bucket, the decode executor, and its boundary
+        variant — then wipe the warmup garbage from the slot state. Returns
+        the number of fresh executor builds; after it, mixed-length traffic
+        compiles nothing (pinned by tests)."""
+        if config is not None and dataclasses.replace(
+            config, max_new_tokens=self.config.max_new_tokens
+        ) != self.config:
+            raise ValueError(
+                "slot engine warmup config must match the engine config "
+                "(only max_new_tokens may differ)"
+            )
+        if any(s is not None for s in self._slots):
+            # warmup ends by blanking the device state; doing that under
+            # resident requests would silently decode them from zeroed caches
+            raise RuntimeError(
+                "warmup() with requests resident in slots would corrupt "
+                "their decode state; warm up before traffic or after drain()"
+            )
+        cfg = self.config
+        before = executor_cache_stats()["misses"]
+        max_prefix = self.model.max_prefix_len
+        for bucket_len in self.table.prompt_lens:
+            if bucket_len - min(bucket_len, cfg.num_latents) > max_prefix:
+                continue
+            ids = jnp.full((1, bucket_len), cfg.pad_token_id, jnp.int32)
+            pad = jnp.zeros((1,), jnp.int32)
+            self._state = self._prefill_executor(bucket_len)(
+                self.params, ids, pad, np.int32(0), self._state
+            )
+        for boundary in (False, True):
+            self._rng, key = jax.random.split(self._rng)
+            self._state, _ = self._decode_executor(boundary)(
+                self.params, self._state, key
+            )
+        self._state = _blank_state(
+            self.model, self.params, self.slots, cfg.pad_token_id
+        )
+        return executor_cache_stats()["misses"] - before
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        out = super().stats()
+        counts = self.registry.counters()
+        rows = counts.get("serving_decode_rows_total", 0)
+        padded = counts.get("serving_decode_rows_padded_total", 0)
+        reg = self.registry
+        out.update({
+            "engine": "slots",
+            "slots": self.slots,
+            "slots_active": sum(1 for s in self._slots if s is not None),
+            "decode_steps": int(counts.get("serving_decode_steps_total", 0)),
+            "prefills": int(counts.get("serving_prefills_total", 0)),
+            "slot_occupancy": round((rows - padded) / max(1.0, rows), 4),
+            "decode_rows_padding_waste": round(padded / max(1.0, rows), 4),
+            "decode_step_ms": {
+                "p50": _round_ms(reg.percentile("serving_decode_step_ms", 50.0)),
+                "p95": _round_ms(reg.percentile("serving_decode_step_ms", 95.0)),
+            },
+        })
+        return out
+
+    def health(self) -> dict:
+        out = super().health()
+        out["slots"] = self.slots
+        out["slots_active"] = sum(1 for s in self._slots if s is not None)
+        return out

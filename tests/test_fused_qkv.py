@@ -132,3 +132,55 @@ def test_executor_cache_keys_on_fused_flag(fused_env):
     assert after["misses"] == mid["misses"] and after["hits"] - mid["hits"] == 1
     np.testing.assert_array_equal(out0, out2)
     np.testing.assert_array_equal(out0, out1)  # fused path is exact anyway
+
+
+def test_executor_cache_keys_on_flash_env_flags():
+    """The remaining trace-time env knobs (PERCEIVER_FLASH_MIN_KV /
+    PERCEIVER_FLASH_BLOCKS) are folded into the executor cache keys exactly
+    like PERCEIVER_FUSED_QKV (``modules.trace_env_fingerprint``): a
+    mid-process toggle rebuilds the executor, toggling back HITs the
+    original — never a silent no-op. On CPU the flash path never dispatches,
+    so outputs are identical across all three calls (the rebuild is about
+    key hygiene, not numerics here)."""
+    from perceiver_io_tpu.inference.generate import (
+        GenerationConfig,
+        executor_cache_stats,
+        generate,
+    )
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+    from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=43, max_seq_len=16, max_latents=8, num_channels=16,
+        num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(cfg)
+    ids = jnp.asarray(np.random.default_rng(3).integers(1, 43, (1, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32), 8)["params"]
+    gcfg = GenerationConfig(
+        max_new_tokens=3, num_latents=2, sampling=SamplingConfig(temperature=0.0)
+    )
+
+    old = {k: os.environ.get(k) for k in ("PERCEIVER_FLASH_MIN_KV", "PERCEIVER_FLASH_BLOCKS")}
+    try:
+        os.environ.pop("PERCEIVER_FLASH_MIN_KV", None)
+        fp0 = trace_env_fingerprint()
+        out0 = np.asarray(generate(model, params, ids, gcfg))
+        before = executor_cache_stats()
+        os.environ["PERCEIVER_FLASH_MIN_KV"] = "2048"
+        assert trace_env_fingerprint() != fp0
+        out1 = np.asarray(generate(model, params, ids, gcfg))
+        mid = executor_cache_stats()
+        assert mid["misses"] - before["misses"] == 1  # fresh executor, not reuse
+        os.environ.pop("PERCEIVER_FLASH_MIN_KV", None)
+        out2 = np.asarray(generate(model, params, ids, gcfg))
+        after = executor_cache_stats()
+        assert after["misses"] == mid["misses"] and after["hits"] - mid["hits"] == 1
+        np.testing.assert_array_equal(out0, out1)
+        np.testing.assert_array_equal(out0, out2)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

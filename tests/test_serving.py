@@ -367,7 +367,11 @@ def test_bench_serve_probe_tiny(tiny_model):
     spec.loader.exec_module(bench)
 
     model, params = tiny_model
-    out = bench._bench_serve(model, params, model.config, n_requests=6, new_tokens=2)
+    # with_ab=False: the slots-vs-bucket A/B has its own tiny probe test
+    # (tests/test_slots.py) — running it twice would bloat the tier-1 budget
+    out = bench._bench_serve(
+        model, params, model.config, n_requests=6, new_tokens=2, with_ab=False
+    )
     assert out["tokens_per_sec"] > 0
     assert out["compile_count"] >= 1
     assert out["steady_state_compiles"] == 0  # second pass fully warm
