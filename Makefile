@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos obs cov bench serve-bench dryrun lint
+.PHONY: test test-fast chaos obs decode-strategy decode-tune cov bench serve-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -18,6 +18,18 @@ chaos:
 # also included in the tier-1 "not slow" run
 obs:
 	$(PY) -m pytest tests/ -q -m observability --continue-on-collection-errors
+
+# decode-strategy suite (per-phase cached-vs-recompute + chunked prefill;
+# docs/serving.md, docs/benchmarks.md) — CPU-fast, also tier-1
+decode-strategy:
+	$(PY) -m pytest tests/ -q -m decode_strategy --continue-on-collection-errors
+
+# boundary-phase autotune probe on CPU: measures cached vs recompute at a
+# small shape and prints the chosen strategy (persist with --out; the serve
+# CLI's --serve.decode_strategy=auto warmup runs the same probe at the
+# deployed shape)
+decode-tune:
+	$(PY) -m perceiver_io_tpu.inference.decode_strategy --ctx 512 --num-latents 64 --num-channels 64 --num-layers 2
 
 cov:
 	$(PY) -m pytest tests/ -q --cov=perceiver_io_tpu --cov-report=term-missing

@@ -14,12 +14,20 @@ computed and masked), so the scaling axis is context length, not prompt
 length. Prints one JSON line per point and a markdown table suitable for
 ``docs/benchmarks.md``.
 
+Boundary-phase points also feed the decode-strategy registry
+(``inference/decode_strategy.py``): each point records the autotuner's
+chosen strategy for its shape, the summary reports the cached/recompute
+crossing point across context lengths, and ``--emit-strategy PATH`` writes
+the same JSON artifact the strategy persistence layer consumes — so a
+scaling study doubles as a deployment's warmup measurement.
+
 Usage::
 
     python examples/perf/decode_scaling.py                  # boundary, 1k->8k
     python examples/perf/decode_scaling.py --phase latent   # the cache's win
     python examples/perf/decode_scaling.py --ctxs 1024 2048 # subset
     python examples/perf/decode_scaling.py --tpu            # real chip
+    python examples/perf/decode_scaling.py --emit-strategy strategy.json
 """
 from __future__ import annotations
 
@@ -52,6 +60,12 @@ def main() -> None:
         "vs the recompute path's full window)",
     )
     p.add_argument("--out", default=None, help="also append JSON lines here")
+    p.add_argument(
+        "--emit-strategy", default=None,
+        help="write the decode-strategy registry JSON artifact here (the "
+        "file inference/decode_strategy.py persistence consumes; boundary "
+        "phase only)",
+    )
     args = p.parse_args()
     if args.phase == "latent" and args.new_tokens >= args.num_latents:
         p.error(
@@ -69,6 +83,7 @@ def main() -> None:
     import numpy as np
 
     from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference import decode_strategy as strategy_mod
     from perceiver_io_tpu.inference.generate import GenerationConfig, generate
     from perceiver_io_tpu.models.text.clm import (
         CausalLanguageModel,
@@ -129,18 +144,58 @@ def main() -> None:
         point["speedup"] = round(
             point["cached_tokens_per_sec"] / point["recompute_tokens_per_sec"], 2
         )
+        if args.phase == "boundary":
+            # record this shape's verdict in the decode-strategy registry —
+            # the measurement the warmup autotuner would repeat, reusing the
+            # timings just taken instead of re-running the probe
+            chosen = (
+                "cached"
+                if point["cached_ms_per_token"] <= point["recompute_ms_per_token"]
+                else "recompute"
+            )
+            strategy_mod.record(
+                model, chosen,
+                cached_ms_per_token=point["cached_ms_per_token"],
+                recompute_ms_per_token=point["recompute_ms_per_token"],
+                batch=args.batch, new_tokens=args.new_tokens,
+                source="decode_scaling",
+            )
+            point["chosen_strategy"] = chosen
+            point["cached_over_recompute"] = point["speedup"]
         rows.append(point)
         print(json.dumps(point), flush=True)
         if args.out:
             with open(args.out, "a") as f:
                 f.write(json.dumps(point) + "\n")
+    if args.emit_strategy and args.phase == "boundary":
+        strategy_mod.save_registry(args.emit_strategy)
+        print(f"wrote decode-strategy artifact: {args.emit_strategy}",
+              file=sys.stderr)
 
-    print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup |")
-    print("|---|---|---|---|---|---|")
-    for r in rows:
-        print(f"| {r['ctx']} | {r['cached_tokens_per_sec']} | "
-              f"{r['recompute_tokens_per_sec']} | {r['cached_ms_per_token']} | "
-              f"{r['recompute_ms_per_token']} | {r['speedup']}x |")
+    if args.phase == "boundary":
+        print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup | chosen |")
+        print("|---|---|---|---|---|---|---|")
+        for r in rows:
+            print(f"| {r['ctx']} | {r['cached_tokens_per_sec']} | "
+                  f"{r['recompute_tokens_per_sec']} | {r['cached_ms_per_token']} | "
+                  f"{r['recompute_ms_per_token']} | {r['speedup']}x | "
+                  f"{r['chosen_strategy']} |")
+        # the cached/recompute crossing point: the first context length at
+        # which the cached boundary step wins (None = recompute everywhere)
+        crossover = next(
+            (r["ctx"] for r in rows if r["chosen_strategy"] == "cached"), None
+        )
+        print(json.dumps({
+            "crossover_ctx": crossover,
+            "chosen_by_ctx": {str(r["ctx"]): r["chosen_strategy"] for r in rows},
+        }))
+    else:
+        print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup |")
+        print("|---|---|---|---|---|---|")
+        for r in rows:
+            print(f"| {r['ctx']} | {r['cached_tokens_per_sec']} | "
+                  f"{r['recompute_tokens_per_sec']} | {r['cached_ms_per_token']} | "
+                  f"{r['recompute_ms_per_token']} | {r['speedup']}x |")
 
 
 if __name__ == "__main__":

@@ -7,6 +7,11 @@ incrementally and the prefix-growth phase via a cross-k/v cache with per-step
 boundary migration (see ``generate.py`` docstring for the phase analysis).
 """
 from perceiver_io_tpu.inference.samplers import SamplingConfig, sample_logits
+from perceiver_io_tpu.inference.decode_strategy import (
+    DecodeStrategy,
+    autotune_boundary,
+    resolve_decode_strategy,
+)
 from perceiver_io_tpu.inference.generate import (
     GenerationConfig,
     executor_cache_stats,
@@ -32,6 +37,9 @@ __all__ = [
     "sample_logits",
     "generate",
     "GenerationConfig",
+    "DecodeStrategy",
+    "autotune_boundary",
+    "resolve_decode_strategy",
     "executor_cache_stats",
     "reset_executor_caches",
     "beam_search",

@@ -1,0 +1,383 @@
+"""Per-phase decode strategies: cached vs recompute, chosen by measurement.
+
+The decode loop passes through three cache phases (``generate.py`` module
+docstring): latent growth (cached step runs O(1) tokens of compute — a
+measured ~6× win at every context length, docs/benchmarks.md), prefix
+growth ("boundary" — the cache elides only the ``2·n·c²`` full-window
+embedding + cross-k/v projections while the latent stack is recomputed
+either way), and the sliding window (recompute is semantically forced by
+the learned absolute position embedding). Round-5 measurements showed the
+cached boundary step *losing* to full recompute on CPU (0.83–0.97× at
+1k–8k ctx): whether the elision beats its own bookkeeping is a platform
+and shape question — exactly the portable-caching tradeoff of the
+compiler-first O(1)-caching paper (PAPERS.md) — so it should be a
+*measured choice*, not a hardcoded one.
+
+This module is that choice:
+
+- :class:`DecodeStrategy` — the per-phase table ``{latent, boundary,
+  window} -> {cached, recompute}``. Both boundary implementations are
+  exact (the cached step's gather+attend is bitwise identical to the
+  uncached forward), so greedy output is token-identical across every
+  strategy — pinned by ``tests/test_decode_strategy.py``.
+- :func:`resolve` — strategy resolution for ``generate()`` and the
+  serving engines: explicit argument > ``PERCEIVER_DECODE_STRATEGY`` env
+  var > ``"auto"`` (registry lookup, falling back to ``cached`` when
+  nothing has been measured — the status-quo default).
+- :func:`autotune_boundary` — the warmup-time autotuner: microbenchmark
+  cached vs recompute boundary-phase decoding at the bound shape, pick
+  the winner, memoize it in a process registry keyed by
+  ``(shape, platform, modules.trace_env_fingerprint())``. With optional
+  JSON persistence (``persist=`` / ``PERCEIVER_DECODE_STRATEGY_FILE``) a
+  deployment measures once and every later process loads the verdict.
+- ``python -m perceiver_io_tpu.inference.decode_strategy`` — the
+  standalone probe behind ``make decode-tune``;
+  ``examples/perf/decode_scaling.py`` emits the same JSON artifact.
+
+The registry key deliberately excludes batch size (the cached-vs-recompute
+tradeoff is a per-row FLOP balance; both sides scale with batch) so one
+warmup measurement covers every micro-batch shape an engine dispatches.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Optional, Union
+
+MODES = ("auto", "cached", "recompute")
+PHASE_CHOICES = ("cached", "recompute")
+
+#: env var overriding the boundary-phase strategy process-wide
+ENV_VAR = "PERCEIVER_DECODE_STRATEGY"
+#: env var pointing at a persisted strategy-registry JSON file
+ENV_FILE = "PERCEIVER_DECODE_STRATEGY_FILE"
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeStrategy:
+    """Per-phase cache strategy table. ``window`` is pinned to recompute —
+    with the reference's learned absolute position embedding an incremental
+    sliding-window step is semantically impossible, not merely slow
+    (``generate.py`` module docstring). ``latent == "recompute"`` forces
+    the boundary phase to recompute too: the boundary cache is built by
+    the prefill/latent steps, so skipping them leaves it stale."""
+
+    latent: str = "cached"
+    boundary: str = "cached"
+    window: str = "recompute"
+
+    def __post_init__(self):
+        for phase in ("latent", "boundary"):
+            value = getattr(self, phase)
+            if value not in PHASE_CHOICES:
+                raise ValueError(
+                    f"{phase} strategy must be one of {PHASE_CHOICES}, got {value!r}"
+                )
+        if self.window != "recompute":
+            raise ValueError(
+                "window strategy is pinned to 'recompute': the learned "
+                "absolute position embedding re-positions every surviving "
+                "token each step, so no exact incremental form exists"
+            )
+
+    @property
+    def boundary_cached(self) -> bool:
+        return self.latent == "cached" and self.boundary == "cached"
+
+
+#: (shape_key, platform, trace_env_fingerprint) -> measurement entry dict
+_REGISTRY: dict = {}
+_FILE_LOADED: set = set()  # paths already merged into _REGISTRY
+
+
+def shape_key(model) -> tuple:
+    """The architecture coordinates the boundary tradeoff depends on —
+    window size (the elided ``2·n·c²`` work), latent count and stack depth
+    (the recomputed-in-both-paths work), and width/heads."""
+    cfg = model.config
+    return (
+        int(cfg.max_seq_len),
+        int(cfg.max_latents),
+        int(cfg.num_channels),
+        int(cfg.num_heads),
+        int(cfg.num_self_attention_layers),
+    )
+
+
+def registry_key(model, platform: Optional[str] = None) -> tuple:
+    from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
+
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return (shape_key(model), str(platform), trace_env_fingerprint())
+
+
+def _maybe_load_env_file() -> None:
+    path = os.environ.get(ENV_FILE)
+    if path and path not in _FILE_LOADED and os.path.exists(path):
+        load_registry(path)
+
+
+def lookup(model, platform: Optional[str] = None) -> Optional[str]:
+    """Measured boundary winner for this shape/platform/env, or None."""
+    _maybe_load_env_file()
+    entry = _REGISTRY.get(registry_key(model, platform))
+    return None if entry is None else entry["boundary"]
+
+
+def record(model, boundary: str, *, platform: Optional[str] = None,
+           **extra) -> dict:
+    """Store a boundary verdict (plus measurement metadata) for this
+    shape/platform/env; returns the entry. Used by the autotuner and by
+    ``examples/perf/decode_scaling.py`` so the scaling study feeds the same
+    registry the serving warmup reads."""
+    if boundary not in PHASE_CHOICES:
+        raise ValueError(f"boundary must be one of {PHASE_CHOICES}, got {boundary!r}")
+    entry = {"boundary": boundary, **extra}
+    _REGISTRY[registry_key(model, platform)] = entry
+    return entry
+
+
+def reset_registry() -> None:
+    """Test isolation: drop every memoized verdict and forget loaded files."""
+    _REGISTRY.clear()
+    _FILE_LOADED.clear()
+
+
+def _key_to_json(key: tuple) -> dict:
+    shape, platform, env = key
+    return {"shape": list(shape), "platform": platform, "env": repr(env)}
+
+
+def _key_from_json(obj: dict) -> tuple:
+    # env fingerprints are tuples of primitives; repr round-trips via eval-free
+    # literal parsing
+    import ast
+
+    return (tuple(obj["shape"]), obj["platform"], ast.literal_eval(obj["env"]))
+
+
+def save_registry(path: str) -> None:
+    """Persist every memoized verdict as the deployment JSON artifact
+    (atomic write; ``load_registry`` and ``PERCEIVER_DECODE_STRATEGY_FILE``
+    consume it)."""
+    entries = [
+        {"key": _key_to_json(key), **entry} for key, entry in sorted(
+            _REGISTRY.items(), key=lambda kv: repr(kv[0])
+        )
+    ]
+    tmp = path + ".tmp"
+    dirpath = os.path.dirname(path)
+    if dirpath:
+        os.makedirs(dirpath, exist_ok=True)
+    with open(tmp, "w") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+    os.replace(tmp, path)
+
+
+def load_registry(path: str) -> int:
+    """Merge a persisted artifact into the process registry; returns the
+    number of entries loaded. Unparseable files load zero entries rather
+    than raising (a corrupt cache must degrade to re-measurement, not take
+    serving down)."""
+    _FILE_LOADED.add(path)
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return 0
+    entries = data.get("entries") if isinstance(data, dict) else None
+    if not isinstance(entries, list):
+        return 0
+    loaded = 0
+    for item in entries:
+        if not isinstance(item, dict):
+            continue
+        try:
+            key = _key_from_json(item["key"])
+            entry = {k: v for k, v in item.items() if k != "key"}
+            if entry.get("boundary") not in PHASE_CHOICES:
+                continue
+        except (KeyError, ValueError, SyntaxError, TypeError):
+            continue
+        _REGISTRY[key] = entry
+        loaded += 1
+    return loaded
+
+
+def resolve(
+    mode: Union[None, str, DecodeStrategy],
+    model=None,
+    *,
+    platform: Optional[str] = None,
+) -> DecodeStrategy:
+    """Resolve a strategy request into a concrete :class:`DecodeStrategy`.
+
+    Order: an explicit :class:`DecodeStrategy` wins; an explicit mode
+    string next; then :data:`ENV_VAR`; then ``"auto"``. ``"auto"`` means
+    "use the measured winner for this shape/platform/env when one exists,
+    else keep the cached default" — so an untuned process behaves exactly
+    like the pre-strategy code.
+    """
+    if isinstance(mode, DecodeStrategy):
+        return mode
+    if mode is None:
+        mode = os.environ.get(ENV_VAR) or "auto"
+    if mode not in MODES:
+        raise ValueError(
+            f"decode strategy must be one of {MODES} (or a DecodeStrategy), "
+            f"got {mode!r}"
+        )
+    if mode == "auto":
+        measured = lookup(model, platform) if model is not None else None
+        return DecodeStrategy(boundary=measured or "cached")
+    return DecodeStrategy(boundary=mode)
+
+
+#: package-level export name (``resolve`` is ambiguous outside this module)
+resolve_decode_strategy = resolve
+
+
+def autotune_boundary(
+    model,
+    params,
+    *,
+    batch: int = 1,
+    new_tokens: int = 4,
+    clock: Callable[[], float] = time.perf_counter,
+    persist: Optional[str] = None,
+    force: bool = False,
+) -> str:
+    """Measure cached vs recompute boundary-phase decoding at the bound
+    shape and memoize the winner; returns ``"cached"`` or ``"recompute"``.
+
+    The probe pins every generated token into the boundary phase (latents
+    start maxed, the prompt fills the window minus ``new_tokens`` — the
+    ``decode_scaling.py`` recipe), runs each implementation once to compile
+    and once timed on ``clock``, and records both per-token times. Ties
+    (including the all-zero durations an un-advanced
+    :class:`~perceiver_io_tpu.reliability.FakeClock` produces) break toward
+    ``cached`` — deterministically, so chaos-clock tests replay. A shape
+    whose window equals its latent count has no boundary phase at all; the
+    verdict is recorded as ``cached`` without measuring.
+
+    :param persist: JSON path — merged before deciding (a persisted verdict
+        short-circuits the measurement unless ``force``) and rewritten
+        after, so one deployment measures once.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+
+    if persist:
+        load_registry(persist)
+    _maybe_load_env_file()
+    key = registry_key(model)
+    if not force and key in _REGISTRY:
+        return _REGISTRY[key]["boundary"]
+
+    n = model.max_seq_len
+    max_latents = model.max_latents
+    boundary_room = n - max_latents  # == max_prefix_len for this family
+    if boundary_room < 1:
+        record(model, "cached", note="no boundary phase at this shape")
+        if persist:
+            save_registry(persist)
+        return "cached"
+    new_tokens = max(1, min(new_tokens, boundary_room))
+    prompt_len = n - new_tokens
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, model.config.vocab_size, size=(batch, prompt_len),
+                     dtype=np.int32)
+    )
+    # latents start maxed: every generated token migrates the boundary
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=max_latents)
+
+    timings = {}
+    for mode in PHASE_CHOICES:
+        ids = generate(model, params, prompt, gcfg, decode_strategy=mode)
+        int(np.asarray(jax.device_get(ids))[0, -1])  # compile + fence
+        t0 = clock()
+        ids = generate(model, params, prompt, gcfg, decode_strategy=mode)
+        int(np.asarray(jax.device_get(ids))[0, -1])
+        timings[mode] = (clock() - t0) / new_tokens * 1e3
+    winner = "cached" if timings["cached"] <= timings["recompute"] else "recompute"
+    record(
+        model, winner,
+        cached_ms_per_token=round(timings["cached"], 4),
+        recompute_ms_per_token=round(timings["recompute"], 4),
+        batch=batch, new_tokens=new_tokens,
+    )
+    if persist:
+        save_registry(persist)
+    return winner
+
+
+def main(argv=None) -> dict:
+    """``make decode-tune``: run the autotune probe on a CLM shape (CPU by
+    default) and print the verdict + measurements as one JSON line."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--ctx", type=int, default=512)
+    p.add_argument("--num-latents", type=int, default=64)
+    p.add_argument("--num-channels", type=int, default=64)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--new-tokens", type=int, default=4)
+    p.add_argument("--out", default=None,
+                   help="persist the registry JSON artifact here")
+    p.add_argument("--tpu", action="store_true",
+                   help="run on the default accelerator backend (else force CPU)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.models.text.clm import (
+        CausalLanguageModel,
+        CausalLanguageModelConfig,
+    )
+
+    cfg = CausalLanguageModelConfig(
+        vocab_size=262,
+        max_seq_len=args.ctx,
+        max_latents=args.num_latents,
+        num_channels=args.num_channels,
+        num_heads=args.num_heads,
+        num_self_attention_layers=args.num_layers,
+    )
+    model = CausalLanguageModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, args.ctx), jnp.int32),
+        args.ctx - args.num_latents,
+    )["params"]
+    winner = autotune_boundary(
+        model, params, batch=args.batch, new_tokens=args.new_tokens,
+        persist=args.out, force=True,
+    )
+    entry = dict(_REGISTRY[registry_key(model)])
+    out = {
+        "boundary": winner,
+        "platform": jax.default_backend(),
+        "shape": list(shape_key(model)),
+        **entry,
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -223,6 +223,117 @@ def _decode_prefill(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     return logits, cache, length, m
 
 
+def _prefill_chunk_kv(mdl, tokens: jnp.ndarray, offset: jnp.ndarray):
+    """Cross k/v (``kv_norm``-side) for a fixed-size chunk of **prefix**
+    token positions — the unit of chunked prefill (``serving/slots.py``).
+
+    The full prefill's cross-k/v cache is per-position math: embedding at
+    the token's absolute index, ``kv_norm``, k/v projection with rotary at
+    angle ``p`` (:func:`_decode_prefill`'s left-aligned layout). None of it
+    couples positions, so a chunk of ``C`` consecutive prefix positions
+    computes values identical to the one-shot full-window pass — which is
+    what lets the slot engine split a long admission into bounded-stall
+    pieces interleaved with resident decode steps.
+
+    :param tokens: ``(b, C)`` token ids at absolute indices
+        ``offset .. offset + C - 1``.
+    :param offset: traced scalar — the chunk's first absolute token index
+        (one compiled program serves every chunk of every bucket).
+    :return: ``(k, v)`` of shape ``(b, h, C, d)`` for those positions.
+    """
+    ar = mdl.perceiver_ar
+    b, c = tokens.shape
+    pos = jnp.broadcast_to(
+        offset + jnp.arange(c, dtype=jnp.int32)[None, :], (b, c)
+    )
+    emb, frq = ar.input_adapter(tokens, abs_pos=pos)
+    ca = ar.cross_attention.cross_attn
+    return ca.attention.project_kv(ca.kv_norm(emb), RotaryEmbedding(frq))
+
+
+def _prefill_finalize(mdl, window: jnp.ndarray, pad_count: jnp.ndarray,
+                      m: jnp.ndarray, cross_k, cross_v):
+    """Complete a chunked prefill: with the prefix cross k/v already staged
+    by :func:`_prefill_chunk_kv` calls, project the ``m`` real latents'
+    ``q_norm``-side k/v into the cache, attend the latent segment over the
+    cache gathered back into window-slot alignment (pad slots gather
+    garbage the pad mask zeroes out — the :func:`_decode_step_boundary`
+    argument), and run the self-attention stack capturing its caches.
+
+    Returns the same ``(logits, cache, length, m)`` contract as
+    :func:`_decode_prefill`, so the slot engine inserts either path's
+    output identically.
+    """
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    rows = jnp.arange(b)
+
+    # Latent segment (last max_latents window slots) at true token indices;
+    # p_seg < 0 marks pad slots (prompt shorter than the latent budget).
+    p_seg = jnp.arange(n - num_latents, n)[None, :] - pad_count[:, None]
+    lat_abs = jnp.maximum(p_seg, 0)
+    emb_lat, frq_lat = ar.input_adapter(window[:, n - num_latents:], abs_pos=lat_abs)
+    x_q_lat = ca.q_norm(emb_lat)
+
+    # q_norm-side k/v of the m real latents, written at their abs indices.
+    # Segment slots that are prefix-classified (m < max_latents) or pads
+    # route to the out-of-bounds sentinel ``n`` and are DROPPED: their
+    # kv_norm-side entries came from the chunk passes and must survive.
+    k_lat, v_lat = mha.project_kv(x_q_lat, RotaryEmbedding(frq_lat))
+    is_real = jnp.arange(num_latents)[None, :] >= num_latents - m
+    idx = jnp.where(is_real, jnp.clip(p_seg, 0, n - 1), n)
+    cross_k = cross_k.at[rows[:, None], :, idx].set(
+        k_lat.transpose(0, 2, 1, 3), mode="drop"
+    )
+    cross_v = cross_v.at[rows[:, None], :, idx].set(
+        v_lat.transpose(0, 2, 1, 3), mode="drop"
+    )
+
+    # Gather into window-slot alignment and attend exactly as
+    # _decode_prefill's direct pass does (masking included).
+    slot_abs = jnp.maximum(jnp.arange(n)[None, :] - pad_count[:, None], 0)
+    k_slots = jnp.take_along_axis(cross_k, slot_abs[:, None, :, None], axis=2)
+    v_slots = jnp.take_along_axis(cross_v, slot_abs[:, None, :, None], axis=2)
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    q = mha.project_q(x_q_lat, RotaryEmbedding(frq_lat, right_align=True))
+    attn = mha.attend(q, k_slots, v_slots, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb_lat
+    x = layer.mlp(x) + x
+
+    # Self-attention stack with per-layer cache capture (_decode_prefill's
+    # loop verbatim: same masks, same first-layer-rotary semantics).
+    stack_pad = jnp.broadcast_to(
+        jnp.arange(num_latents)[None, :] < num_latents - m, (b, num_latents)
+    )
+    rot_latent = RotaryEmbedding(frq_lat, right_align=True)
+    seg_idx = jnp.clip(num_latents - m + jnp.arange(num_latents), 0, num_latents - 1)
+    stack_k, stack_v = [], []
+    for i, sa_layer in enumerate(ar.self_attention.layers):
+        sa = sa_layer.self_attn
+        r = rot_latent if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        stack_k.append(jnp.take_along_axis(k_s, seg_idx[None, None, :, None], axis=2))
+        stack_v.append(jnp.take_along_axis(v_s, seg_idx[None, None, :, None], axis=2))
+        attn = sa.attention.attend(q_s, k_s, v_s, pad_mask=stack_pad, deterministic=True)
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    length = (n - pad_count).astype(jnp.int32)
+    cache = {"cross_k": cross_k, "cross_v": cross_v,
+             "stack_k": stack_k, "stack_v": stack_v}
+    return logits, cache, length, m
+
+
 def _decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray, m: jnp.ndarray):
     """One cached decode step: run ONLY the new token through the model,
     attending over the caches — valid while the new token is a fresh latent
@@ -349,7 +460,8 @@ def _slot_decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray,
 
 
 def _decode_step_boundary(
-    mdl, window: jnp.ndarray, pad_count: jnp.ndarray, cross_k, cross_v, length
+    mdl, window: jnp.ndarray, pad_count: jnp.ndarray, cross_k, cross_v, length,
+    write_idx: Optional[jnp.ndarray] = None,
 ):
     """One cached decode step for the **prefix-growth** phase (the latent
     count is pinned at ``max_latents`` and the boundary migrates one position
@@ -365,6 +477,12 @@ def _decode_step_boundary(
       becomes prefix — its k/v are recomputed ``kv_norm``-side (the
       boundary-side normalization swap, reference ``modules.py:188-203``).
 
+    Both cache updates land in ONE fused scatter per array (the step is
+    bookkeeping-bound on CPU — docs/benchmarks.md round-5 curves — so the
+    fixed per-step overhead matters as much as the FLOPs). The migrated and
+    appended indices are always distinct (``length - max_latents`` vs
+    ``length``), so the fused scatter stays deterministic.
+
     Every latent attends to the migrated key, so all latent cross-attention
     outputs and the self-attention stack are recomputed (their inputs
     changed); the cache elides the ``2·n·c²`` full-window k/v projections
@@ -376,6 +494,10 @@ def _decode_step_boundary(
     :param pad_count: ``(b,)`` left-pad counts *after* the append.
     :param cross_k/cross_v: ``(b, h, N, d)`` abs-indexed cross k/v cache.
     :param length: ``(b,)`` real-token count *before* the append.
+    :param write_idx: optional ``(b, 2)`` precomputed ``[migrated index,
+        append index]`` — the generation executor hoists this arithmetic
+        out of the scan body; None derives it from ``pad_count``/``length``
+        (the slot engine's per-call path).
     :return: (next-token logits, cross_k, cross_v, length + 1).
     """
     ar = mdl.perceiver_ar
@@ -386,6 +508,12 @@ def _decode_step_boundary(
     mha = ca.attention
     rows = jnp.arange(b)
 
+    if write_idx is None:
+        mig_abs = jnp.maximum((n - num_latents - 1) - pad_count[:, None], 0)
+        write_idx = jnp.concatenate([mig_abs, length[:, None]], axis=1)
+    else:
+        mig_abs = write_idx[:, :1]
+
     # Latent segment: the last max_latents window slots, all real tokens
     # (guaranteed by the caller's phase-2 precondition).
     lat_abs = jnp.maximum(
@@ -395,20 +523,20 @@ def _decode_step_boundary(
     x_q_lat = ca.q_norm(emb_lat)
 
     # Boundary migration: recompute the ex-latent's k/v kv_norm-side.
-    mig_abs = jnp.maximum((n - num_latents - 1) - pad_count[:, None], 0)
     emb_mig, frq_mig = ar.input_adapter(
         window[:, n - num_latents - 1 : n - num_latents], abs_pos=mig_abs
     )
     k_mig, v_mig = mha.project_kv(ca.kv_norm(emb_mig), RotaryEmbedding(frq_mig))
-    cross_k = cross_k.at[rows, :, mig_abs[:, 0]].set(k_mig[:, :, 0])
-    cross_v = cross_v.at[rows, :, mig_abs[:, 0]].set(v_mig[:, :, 0])
 
-    # Append the new token's q_norm-side k/v at its abs index.
+    # The new token's q_norm-side k/v at its abs index, fused with the
+    # migration write: one (b, 2)-indexed scatter per cache array.
     k_new, v_new = mha.project_kv(
         x_q_lat[:, -1:], RotaryEmbedding(frq_lat[:, -1:])
     )
-    cross_k = cross_k.at[rows, :, length].set(k_new[:, :, 0])
-    cross_v = cross_v.at[rows, :, length].set(v_new[:, :, 0])
+    k_upd = jnp.concatenate([k_mig, k_new], axis=2).transpose(0, 2, 1, 3)
+    v_upd = jnp.concatenate([v_mig, v_new], axis=2).transpose(0, 2, 1, 3)
+    cross_k = cross_k.at[rows[:, None], :, write_idx].set(k_upd)
+    cross_v = cross_v.at[rows[:, None], :, write_idx].set(v_upd)
 
     # Gather the abs-indexed cache into window-slot alignment and attend
     # exactly as the uncached forward does (pad slots gather garbage that the
@@ -446,12 +574,21 @@ def generate(
     rng: Optional[jax.Array] = None,
     prompt_pad_count: Optional[jnp.ndarray] = None,
     use_cache: bool = True,
+    decode_strategy=None,
 ) -> jnp.ndarray:
     """Generate ``config.max_new_tokens`` tokens after ``input_ids``.
 
     :param model: an ``AutoregressiveSequenceModel`` (CLM / symbolic audio).
     :param input_ids: ``(b, prompt_len)`` prompt, left-padded if ragged.
     :param prompt_pad_count: ``(b,)`` left-pad counts for ragged prompts.
+    :param decode_strategy: per-phase cache strategy —
+        ``"auto" | "cached" | "recompute"`` or a
+        :class:`~perceiver_io_tpu.inference.decode_strategy.DecodeStrategy`.
+        ``None`` defers to ``PERCEIVER_DECODE_STRATEGY`` then ``"auto"``
+        (the measured winner for this shape when the autotuner has run,
+        else the cached default). Every strategy is exact; greedy output is
+        token-identical across all of them. Beam search (``num_beams > 1``)
+        ignores the strategy (its executor has no boundary segment).
     :return: ``(b, max_new_tokens)`` generated ids (pad after EOS).
     """
     if config.num_beams > 1:
@@ -493,15 +630,24 @@ def generate(
     # latent slots (prompt pads fit in the nominal prefix); phase 3 (slide)
     # is windowed recompute, semantically forced by the learned absolute
     # position embedding (reference window schedule ``clm/huggingface.py:
-    # 53-74``). The schedule is host-side static, so it is part of the
-    # executor cache key rather than traced control flow.
+    # 53-74``). The per-phase cached-vs-recompute choice is the decode
+    # strategy (``inference/decode_strategy.py`` — measured, env- and
+    # flag-overridable; the boundary phase loses to recompute on some
+    # platforms, docs/benchmarks.md). The schedule is host-side static, so
+    # it is part of the executor cache key rather than traced control flow.
+    from perceiver_io_tpu.inference import decode_strategy as _strategy
+
+    strat = _strategy.resolve(decode_strategy, model)
+    latent_cached = use_cache and strat.latent == "cached"
     s1 = (
         min(config.max_new_tokens, max_latents - num_latents, n - prompt_len)
-        if use_cache
+        if latent_cached
         else 0
     )
-    phase2_ok = use_cache and bool(
-        (np.asarray(jax.device_get(prompt_pad_count)) <= prefix_len).all()
+    phase2_ok = (
+        use_cache
+        and strat.boundary_cached
+        and bool((np.asarray(jax.device_get(prompt_pad_count)) <= prefix_len).all())
     )
     s2 = min(config.max_new_tokens, n - prompt_len) if phase2_ok else s1
     s2 = max(s1, s2)
@@ -729,8 +875,19 @@ def _build_generation_executor(
             cross_k, cross_v = cache["cross_k"], cache["cross_v"]
             m_full = jnp.asarray(max_latents, jnp.int32)
 
+            # Hoisted scatter-index arithmetic: the migrated and appended
+            # cache indices are affine in the step counter, so the whole
+            # (T, b, 2) sequence is computed once here and fed through the
+            # scan's xs instead of being re-derived inside every iteration
+            # (the boundary step is bookkeeping-bound on CPU).
+            t_rel = jnp.arange(s2 - s1, dtype=jnp.int32)
+            pad_seq = jnp.maximum(pad_count[None, :] - (t_rel + 1)[:, None], 0)
+            mig_seq = jnp.maximum((n - max_latents - 1) - pad_seq, 0)
+            len_seq = length[None, :] + t_rel[:, None]
+            write_idx_seq = jnp.stack([mig_seq, len_seq], axis=-1)
+
             def boundary_step(carry, xs):
-                step_rng, t = xs
+                step_rng, t, write_idx = xs
                 window, pad_count, finished, logits, cross_k, cross_v, length = carry
                 token = sample_logits(
                     step_rng, mask_eos_until_min(logits, t), config.sampling,
@@ -746,6 +903,7 @@ def _build_generation_executor(
                     cross_k,
                     cross_v,
                     length,
+                    write_idx,
                     method=_decode_step_boundary,
                 )
                 return (
@@ -755,7 +913,8 @@ def _build_generation_executor(
 
             carry = (window, pad_count, finished, logits, cross_k, cross_v, length)
             carry, tokens = jax.lax.scan(
-                boundary_step, carry, (step_rngs[s1:s2], jnp.arange(s1, s2))
+                boundary_step, carry,
+                (step_rngs[s1:s2], jnp.arange(s1, s2), write_idx_seq),
             )
             window, pad_count, finished = carry[0], carry[1], carry[2]
             m0 = m_full

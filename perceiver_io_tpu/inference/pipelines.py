@@ -75,11 +75,15 @@ class TextGenerationPipeline(_Pipeline):
     """
 
     def __init__(self, model, params, tokenizer, *, bucketing: bool = False,
-                 bucket_table=None):
+                 bucket_table=None, decode_strategy: Optional[str] = None):
         super().__init__(model, params)
         self.tokenizer = tokenizer
         self.bucketing = bucketing
         self._bucket_table = bucket_table
+        #: per-phase cache strategy (inference/decode_strategy.py) applied
+        #: to every generate dispatch and the lazily built serving engine;
+        #: None defers to PERCEIVER_DECODE_STRATEGY / the measured registry
+        self.decode_strategy = decode_strategy
         self._engine = None
 
     def _make_config(
@@ -106,7 +110,8 @@ class TextGenerationPipeline(_Pipeline):
             from perceiver_io_tpu.serving import ServingEngine
 
             self._engine = ServingEngine(
-                self.model, self.params, config, table=self._bucket_table
+                self.model, self.params, config, table=self._bucket_table,
+                decode_strategy=self.decode_strategy,
             )
         return self._engine
 
@@ -165,6 +170,7 @@ class TextGenerationPipeline(_Pipeline):
                 config,
                 rng=jax.random.PRNGKey(seed),
                 prompt_pad_count=jnp.asarray(pad_count),
+                decode_strategy=self.decode_strategy,
             ))
         texts = []
         for prompt, row in zip(batch, rows):

@@ -169,6 +169,19 @@ class ServeArgs:
     engine: str = "bucket"
     #: persistent decode slots for ``--serve.engine=slots``
     slots: int = 8
+    #: chunked prefill for the slot engine: split long-prompt admission into
+    #: fixed-size chunks interleaved with resident decode steps (None = off;
+    #: docs/serving.md)
+    prefill_chunk: Optional[int] = None
+    #: boundary-phase decode strategy: ``auto`` measures cached-vs-recompute
+    #: at warmup and memoizes the winner (inference/decode_strategy.py);
+    #: ``cached``/``recompute`` pin it (and beat PERCEIVER_DECODE_STRATEGY,
+    #: which ``auto`` defers to). Exact either way — greedy output is
+    #: token-identical across settings.
+    decode_strategy: str = "auto"
+    #: optional JSON path persisting the autotuner's verdicts, so one
+    #: deployment measures once (also via PERCEIVER_DECODE_STRATEGY_FILE)
+    decode_strategy_file: Optional[str] = None
     #: prompt-length bucket grid; default = powers of two up to the context
     prompt_buckets: Optional[typing.Tuple[int, ...]] = None
     #: micro-batch size grid (``bucket`` engine; ignored by ``slots``)
@@ -184,6 +197,35 @@ class ServeArgs:
     #: per-request deadline in seconds; requests that wait longer complete
     #: with a ``timed_out`` record instead of occupying a bucket slot
     deadline_s: Optional[float] = None
+
+
+def _serve_decode_mode(flag_value: str) -> str:
+    """Resolve ``--serve.decode_strategy`` against the process-wide env
+    override (docs/serving.md). The flag's ``"auto"`` default must not mask
+    ``PERCEIVER_DECODE_STRATEGY`` — ``resolve()`` only consults the env when
+    handed ``None``, and the engine always receives an explicit mode so
+    warmup knows whether to autotune — so ``auto`` defers to the env var
+    while a pinned ``cached``/``recompute`` flag beats it."""
+    import os
+
+    from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+
+    if flag_value not in strategy_mod.MODES:
+        raise SystemExit(
+            "--serve.decode_strategy must be one of "
+            f"{'|'.join(strategy_mod.MODES)}, got {flag_value!r}"
+        )
+    if flag_value != "auto":
+        return flag_value
+    env_mode = os.environ.get(strategy_mod.ENV_VAR)
+    if not env_mode:
+        return flag_value
+    if env_mode not in strategy_mod.MODES:
+        raise SystemExit(
+            f"{strategy_mod.ENV_VAR} must be one of "
+            f"{'|'.join(strategy_mod.MODES)}, got {env_mode!r}"
+        )
+    return env_mode
 
 
 def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
@@ -602,18 +644,32 @@ class CLI:
             raise SystemExit(
                 f"--serve.engine must be 'bucket' or 'slots', got {args.engine!r}"
             )
+        from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+
+        decode_mode = _serve_decode_mode(args.decode_strategy)
+        if args.decode_strategy_file:
+            # persisted verdicts short-circuit the warmup autotune; fresh
+            # verdicts measured this run are written back on warmup
+            strategy_mod.load_registry(args.decode_strategy_file)
         engine_kwargs = dict(
             rng=jax.random.PRNGKey(args.seed),
             max_queue=args.max_queue,
             default_deadline_s=args.deadline_s,
             registry=kit["registry"],
             tracer=tracer,
+            decode_strategy=decode_mode,
         )
         if args.engine == "slots":
             engine = SlotServingEngine(
-                model, params, gen_cfg, table, slots=args.slots, **engine_kwargs
+                model, params, gen_cfg, table, slots=args.slots,
+                prefill_chunk=args.prefill_chunk, **engine_kwargs
             )
         else:
+            if args.prefill_chunk is not None:
+                raise SystemExit(
+                    "--serve.prefill_chunk applies to --serve.engine=slots "
+                    "(the bucket engine has no resident decode to interleave)"
+                )
             engine = ServingEngine(model, params, gen_cfg, table, **engine_kwargs)
         if args.warmup:
             t0 = time.monotonic()
@@ -622,6 +678,8 @@ class CLI:
                 f"[serve] warmup compiled {compiles} executors in "
                 f"{time.monotonic() - t0:.1f}s", file=sys.stderr, flush=True,
             )
+            if args.decode_strategy_file and decode_mode == "auto":
+                strategy_mod.save_registry(args.decode_strategy_file)
 
         if args.prompts:
             with open(args.prompts) as fh:
@@ -715,7 +773,9 @@ class CLI:
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --obs.* --config=<yaml> --data=<name> --ckpt=<dir>")
         print("serve: --ckpt=<dir> --serve.prompts=<file|stdin> --serve.max_new_tokens "
-              "--serve.engine={bucket|slots} --serve.slots "
+              "--serve.engine={bucket|slots} --serve.slots --serve.prefill_chunk "
+              "--serve.decode_strategy={auto|cached|recompute} "
+              "--serve.decode_strategy_file "
               "--serve.prompt_buckets --serve.batch_buckets --serve.warmup "
               "--serve.max_queue --serve.deadline_s")
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "

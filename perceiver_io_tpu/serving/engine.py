@@ -146,6 +146,13 @@ class ServingEngine:
     :param tracer: optional span tracer — one trace per request, one
         terminal ``serving.request`` span per submission, one
         ``serving.batch`` span per micro-batch. None skips every span site.
+    :param decode_strategy: per-phase decode strategy forwarded to every
+        ``generate()`` dispatch — ``"auto" | "cached" | "recompute"``
+        (``inference/decode_strategy.py``). ``None`` defers to
+        ``PERCEIVER_DECODE_STRATEGY`` then the measured registry. With an
+        explicit ``"auto"``, :meth:`warmup` runs the boundary autotuner
+        first so the deployment measures once and compiles against the
+        winner.
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
@@ -155,7 +162,16 @@ class ServingEngine:
                  clock: Callable[[], float] = time.monotonic,
                  chaos=None,
                  registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 decode_strategy: Optional[str] = None):
+        from perceiver_io_tpu.inference import decode_strategy as _strategy
+
+        if decode_strategy is not None and decode_strategy not in _strategy.MODES:
+            raise ValueError(
+                f"decode_strategy must be one of {_strategy.MODES}, "
+                f"got {decode_strategy!r}"
+            )
+        self.decode_strategy = decode_strategy
         self.model = model
         self.params = params
         self.config = config or GenerationConfig()
@@ -469,6 +485,7 @@ class ServingEngine:
                 generate(
                     self.model, self.params, jnp.asarray(ids), cfg,
                     rng=key, prompt_pad_count=jnp.asarray(pad_count),
+                    decode_strategy=self.decode_strategy,
                 )
             )
         except Exception as e:
@@ -523,6 +540,13 @@ class ServingEngine:
         skipped — serve-time scheduling skips them identically."""
         cfg = config or self.config
         before = executor_cache_stats()["misses"]
+        if self.decode_strategy == "auto":
+            # measure the boundary winner ONCE before compiling the grid, so
+            # every warmed executor is the plan steady-state traffic uses
+            # (the probe's two small generation compiles count in the return)
+            from perceiver_io_tpu.inference import decode_strategy as _strategy
+
+            _strategy.autotune_boundary(self.model, self.params)
         max_prefix = self.model.max_prefix_len
         for b, length in self.table.grid():
             nominal_prefix = length - min(length, cfg.num_latents)
@@ -535,7 +559,8 @@ class ServingEngine:
                 ids = jnp.full((b, length), cfg.pad_token_id, jnp.int32)
                 pad_count = jnp.full((b,), pad, jnp.int32)
                 generate(self.model, self.params, ids, cfg,
-                         rng=jax.random.PRNGKey(0), prompt_pad_count=pad_count)
+                         rng=jax.random.PRNGKey(0), prompt_pad_count=pad_count,
+                         decode_strategy=self.decode_strategy)
         return executor_cache_stats()["misses"] - before
 
     # -- observability ------------------------------------------------------

@@ -35,10 +35,34 @@ the per-request trace alive across the slot lifecycle
 (``serving.slot_assigned`` / ``serving.slot_retired`` events on the
 request's trace; docs/observability.md).
 
+**Chunked prefill** (``prefill_chunk=C``): a long-prompt admission is the
+one remaining head-of-line stall — the full-window prefill runs between
+two decode steps, so every resident slot's inter-token latency spikes by
+the whole prompt's cost. With chunking, the prefix cross-k/v cache is
+built ``C`` token positions at a time in a batch-1 *staging* buffer by ONE
+bucket-independent chunk executor (traced offset/slot/m; a final *pure
+finalize* call — the other ``lax.cond`` branch of the same program — runs
+the latent attend + stack and inserts the finished row), one call per
+:meth:`SlotServingEngine.step` interleaved with the resident decode steps
+— the "Ragged Paged Attention" admission pattern (PAPERS.md). The
+persistent state never holds a half-built row, so decode steps between
+chunks stay oblivious.
+
+**Decode strategy** (``decode_strategy=...`` /
+``PERCEIVER_DECODE_STRATEGY``): the boundary decode variant's
+implementation — cached migration step vs full windowed recompute — is a
+measured platform/shape choice (``inference/decode_strategy.py``; the
+cached step loses to recompute on CPU, docs/benchmarks.md). Both are
+exact, so greedy output stays token-identical either way; ``"auto"`` uses
+the autotuner's memoized verdict (``warmup()`` measures it once when asked
+explicitly).
+
 Compile-count guarantee: at most ``len(prompt_buckets)`` prefill executors
-plus one decode executor plus its boundary variant — mixed-length traffic
-causes **zero** additional retraces after :meth:`SlotServingEngine.warmup`
-(pinned by ``tests/test_slots.py``).
+plus one decode executor plus its boundary variant, plus ONE chunked-
+prefill executor when ``prefill_chunk`` is set (``+2 -> +3``) —
+mixed-length traffic causes **zero** additional retraces after
+:meth:`SlotServingEngine.warmup` (pinned by ``tests/test_slots.py`` /
+``tests/test_decode_strategy.py``).
 
 Exactness: for greedy decoding the slot engine is token-identical to
 unbucketed per-request ``generate()``, including requests admitted into
@@ -69,10 +93,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from perceiver_io_tpu.inference import decode_strategy as decode_strategy_mod
 from perceiver_io_tpu.inference.generate import (
     GenerationConfig,
+    _decode_forward,
     _decode_prefill,
     _decode_step_boundary,
+    _prefill_chunk_kv,
+    _prefill_finalize,
     _slot_decode_step,
     cached_executor,
     executor_cache_stats,
@@ -147,16 +175,39 @@ def _blank_state(model, params, slots: int, pad_token_id: int) -> dict:
     }
 
 
+def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m):
+    """Insert one prefilled row (batch-1 caches + row state) into slot
+    ``slot`` of the persistent multi-slot state — shared by the per-bucket
+    prefill executor and the chunked-prefill finalize so the two admission
+    paths cannot drift. ``slot`` and ``m`` may be traced scalars."""
+    def upd(dst, src):
+        return jax.lax.dynamic_update_slice(
+            dst, src.astype(dst.dtype), (slot,) + (0,) * (dst.ndim - 1)
+        )
+
+    new = dict(state)
+    new["cross_k"] = upd(state["cross_k"], cache["cross_k"])
+    new["cross_v"] = upd(state["cross_v"], cache["cross_v"])
+    new["stack_k"] = tuple(
+        upd(d, s) for d, s in zip(state["stack_k"], cache["stack_k"])
+    )
+    new["stack_v"] = tuple(
+        upd(d, s) for d, s in zip(state["stack_v"], cache["stack_v"])
+    )
+    new["window"] = upd(state["window"], window)
+    new["pad"] = upd(state["pad"], pad)
+    new["length"] = upd(state["length"], length.astype(jnp.int32))
+    new["m"] = upd(state["m"], jnp.reshape(m, (1,)).astype(jnp.int32))
+    new["steps"] = upd(state["steps"], jnp.zeros((1,), jnp.int32))
+    new["logits"] = upd(state["logits"], logits)
+    return new
+
+
 def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int):
     """Prefill one request at prompt bucket ``bucket_len`` and insert its
     caches + row state into slot ``slot`` of the persistent state."""
     n = model.max_seq_len
     m0 = min(bucket_len, config.num_latents)
-
-    def upd(dst, src, slot):
-        return jax.lax.dynamic_update_slice(
-            dst, src.astype(dst.dtype), (slot,) + (0,) * (dst.ndim - 1)
-        )
 
     def run(params, ids, pad_count, slot, state):
         window = jnp.full((1, n), config.pad_token_id, ids.dtype)
@@ -166,33 +217,80 @@ def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int):
             {"params": params}, window, pad, jnp.asarray(m0, jnp.int32),
             method=_decode_prefill,
         )
-        new = dict(state)
-        new["cross_k"] = upd(state["cross_k"], cache["cross_k"], slot)
-        new["cross_v"] = upd(state["cross_v"], cache["cross_v"], slot)
-        new["stack_k"] = tuple(
-            upd(d, s, slot) for d, s in zip(state["stack_k"], cache["stack_k"])
+        return _insert_row(
+            state, slot, window=window, pad=pad, logits=logits, cache=cache,
+            length=length, m=jnp.asarray(m0, jnp.int32),
         )
-        new["stack_v"] = tuple(
-            upd(d, s, slot) for d, s in zip(state["stack_v"], cache["stack_v"])
-        )
-        new["window"] = upd(state["window"], window, slot)
-        new["pad"] = upd(state["pad"], pad, slot)
-        new["length"] = upd(state["length"], length.astype(jnp.int32), slot)
-        new["m"] = upd(state["m"], jnp.full((1,), m0, jnp.int32), slot)
-        new["steps"] = upd(state["steps"], jnp.zeros((1,), jnp.int32), slot)
-        new["logits"] = upd(state["logits"], logits, slot)
-        return new
 
     return jax.jit(run, donate_argnums=_donate(4))
 
 
-def _build_decode_executor(model, config: GenerationConfig, boundary: bool):
+def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int):
+    """ONE bucket-independent executor for chunked admission, two
+    ``lax.cond`` branches in one compiled program. Stage calls project the
+    ``kv_norm``-side cross k/v of ``chunk`` prefix token positions into a
+    batch-1 staging cache
+    (:func:`~perceiver_io_tpu.inference.generate._prefill_chunk_kv`); the
+    final call runs ONLY the finalize — latent-side k/v, gathered
+    cross-attention, the self-attention stack
+    (:func:`~..generate._prefill_finalize`) — and inserts caches + row
+    state into slot ``slot``. Keeping the branches disjoint matters for the
+    tail latency the feature exists to cut: the finalize call must not
+    also pay a chunk's staging math, or the admission's worst per-step
+    stall creeps back toward the one-shot prefill's.
+
+    ``offset``, ``m``, ``slot`` and ``is_final`` are traced, so every
+    chunk of every prompt bucket reuses this single program — the
+    compile-count bound grows by exactly one
+    (``len(prompt_buckets) + 2 -> + 3``, pinned by tests)."""
+
+    def run(params, tokens, offset, is_final, window, pad_count, m, slot,
+            stage_k, stage_v, state):
+        def stage(ops):
+            stage_k, stage_v, state = ops
+            k_c, v_c = model.apply(
+                {"params": params}, tokens, offset, method=_prefill_chunk_kv
+            )
+            stage_k = jax.lax.dynamic_update_slice(
+                stage_k, k_c.astype(stage_k.dtype), (0, 0, offset, 0)
+            )
+            stage_v = jax.lax.dynamic_update_slice(
+                stage_v, v_c.astype(stage_v.dtype), (0, 0, offset, 0)
+            )
+            return stage_k, stage_v, state
+
+        def fin(ops):
+            stage_k, stage_v, state = ops
+            logits, cache, length, _ = model.apply(
+                {"params": params}, window, pad_count, m, stage_k, stage_v,
+                method=_prefill_finalize,
+            )
+            state = _insert_row(
+                state, slot, window=window, pad=pad_count, logits=logits,
+                cache=cache, length=length, m=m,
+            )
+            return stage_k, stage_v, state
+
+        return jax.lax.cond(is_final, fin, stage, (stage_k, stage_v, state))
+
+    return jax.jit(run, donate_argnums=_donate(8, 9, 10))
+
+
+def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
+                           boundary_mode: str = "cached"):
     """One fixed-shape token step over all slots: sample each row's next
     token from the resident logits, append it, advance every cache by one
-    token. ``boundary=True`` additionally runs the boundary-migration step
-    and selects per row (``m == max_latents``) — the conservative mixed-
-    phase variant, compiled once and used only while such a row is
-    resident."""
+    token. ``boundary=True`` additionally runs the boundary-phase step for
+    rows whose latent segment is full and selects per row
+    (``m == max_latents``) — the conservative mixed-phase variant, compiled
+    once and used only while such a row is resident. ``boundary_mode``
+    picks that step's implementation per the decode strategy
+    (``inference/decode_strategy.py``): ``"cached"`` runs the cross-cache
+    boundary-migration step, ``"recompute"`` the full windowed forward
+    (exact either way; the winner is a measured platform/shape property —
+    docs/benchmarks.md). Under recompute the boundary rows' cross caches go
+    stale, which is safe: a row never leaves the boundary phase (the
+    sliding-window phase is out of the slot engine's scope)."""
     n = model.max_seq_len
     max_latents = model.max_latents
     min_new = config.min_new_tokens if config.eos_token_id is not None else 0
@@ -224,7 +322,19 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool):
         new_logits = logits_a
         cross_k, cross_v = cache_a["cross_k"], cache_a["cross_v"]
         stack_k, stack_v = cache_a["stack_k"], cache_a["stack_v"]
-        if boundary:
+        if boundary and boundary_mode == "recompute":
+            # Strategy-selected full recompute for boundary rows: the
+            # windowed forward at m = max_latents (garbage for latent rows,
+            # selected away). No cache writes — boundary rows never read
+            # their cross cache again under this mode.
+            logits_b = model.apply(
+                {"params": params}, window, pad,
+                jnp.asarray(max_latents, jnp.int32),
+                method=_decode_forward,
+            )
+            is_b = m >= max_latents
+            new_logits = jnp.where(is_b[:, None], logits_b, logits_a)
+        elif boundary:
             logits_b, ck_b, cv_b, _ = model.apply(
                 {"params": params}, window, pad,
                 state["cross_k"], state["cross_v"], length,
@@ -267,6 +377,30 @@ class _Slot:
     emitted: List[int] = dataclasses.field(default_factory=list)
 
 
+@dataclasses.dataclass
+class _ChunkedAdmit:
+    """Host-side record of one in-flight chunked admission: the reserved
+    slot, the prepared window/row state, the chunk schedule, and the
+    device-side staging caches the chunk executor accumulates into. The
+    persistent slot state is untouched until the finalize call inserts the
+    finished row, so interleaved decode steps can never observe a
+    half-built cache."""
+
+    req: ServeRequest
+    slot: int
+    bucket_len: int
+    m0: int
+    window: np.ndarray  # (1, n) right-aligned ids
+    pad: np.ndarray  # (1,) left-pad count
+    by_index: np.ndarray  # (n,) ids in token-index space (prompt then pad)
+    offsets: List[int]  # staging-chunk start indices; one more pure
+    # finalize call follows the last chunk
+    next_chunk: int = 0
+    stage_k: object = None
+    stage_v: object = None
+    device_ms: float = 0.0  # summed per-chunk executor time
+
+
 class SlotServingEngine(ServingEngine):
     """Token-granular scheduler over the persistent-slot decode state.
 
@@ -279,21 +413,47 @@ class SlotServingEngine(ServingEngine):
     :param slots: number of persistent decode slots ``S`` (the decode
         executor's fixed batch dimension). The bucket table's
         ``batch_sizes`` are ignored; ``prompt_lens`` are the prefill grid.
+    :param prefill_chunk: chunked-prefill chunk size (token positions per
+        chunk-executor call). A request whose prefix exceeds it is admitted
+        incrementally — one chunk per ``step()``, interleaved with resident
+        decode steps, so a long admission no longer stalls resident slots'
+        token cadence. ``None`` (default) keeps every admission on the
+        single-call per-bucket prefill path.
+    :param decode_strategy: boundary-phase decode strategy for the mixed
+        boundary decode variant — ``"auto" | "cached" | "recompute"``.
+        ``None`` defers to ``PERCEIVER_DECODE_STRATEGY`` then the measured
+        registry (cached when untuned). ``warmup()`` runs the autotuner
+        first when set to ``"auto"`` explicitly, so one deployment measures
+        once and every variant compiles against the winner.
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
-                 table=None, *, slots: int = 8, **kwargs):
-        super().__init__(model, params, config, table, **kwargs)
+                 table=None, *, slots: int = 8,
+                 prefill_chunk: Optional[int] = None,
+                 decode_strategy: Optional[str] = None, **kwargs):
+        super().__init__(
+            model, params, config, table, decode_strategy=decode_strategy,
+            **kwargs
+        )
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
         self.slots = int(slots)
+        self.prefill_chunk = (
+            None if prefill_chunk is None
+            else int(min(prefill_chunk, model.max_seq_len))
+        )
         self.registry.declare_counters(
             "serving_decode_steps_total",
             "serving_decode_rows_total",
             "serving_decode_rows_padded_total",
             "serving_prefills_total",
+            "serving_prefill_chunks_total",
         )
         self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._admitting: Optional[_ChunkedAdmit] = None
+        self._pinned_boundary_mode: Optional[str] = None
         self._state = _blank_state(model, params, self.slots, self.config.pad_token_id)
         self._update_slot_gauges()
 
@@ -316,10 +476,38 @@ class SlotServingEngine(ServingEngine):
             lambda: _build_prefill_executor(self.model, self.config, bucket_len),
         )
 
-    def _decode_executor(self, boundary: bool):
+    def _chunked_prefill_executor(self):
         return cached_executor(
-            _EXECUTOR_CACHE, self._cache_key("slot_decode", boundary),
-            lambda: _build_decode_executor(self.model, self.config, boundary),
+            _EXECUTOR_CACHE,
+            self._cache_key("slot_prefill_chunk", self.prefill_chunk),
+            lambda: _build_chunked_prefill_executor(
+                self.model, self.config, self.prefill_chunk
+            ),
+        )
+
+    def _boundary_mode(self) -> str:
+        """Resolved boundary-phase strategy for the mixed decode variant
+        (``decode_strategy`` ctor arg > env var > measured registry >
+        cached), **pinned at first use**. Under recompute the resident
+        boundary rows' cross caches are deliberately left stale, so a
+        mid-serving registry change (a late autotune, a strategy file
+        appearing) must not swap the executor under them — a fresh verdict
+        applies from the next :meth:`warmup` (no residents there), not
+        mid-flight. Pinning also keeps the per-token host path free of the
+        env/file/fingerprint lookups ``resolve`` performs."""
+        if self._pinned_boundary_mode is None:
+            self._pinned_boundary_mode = decode_strategy_mod.resolve(
+                self.decode_strategy, self.model
+            ).boundary
+        return self._pinned_boundary_mode
+
+    def _decode_executor(self, boundary: bool):
+        mode = self._boundary_mode() if boundary else "cached"
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("slot_decode", boundary, mode),
+            lambda: _build_decode_executor(
+                self.model, self.config, boundary, mode
+            ),
         )
 
     # -- feasibility ---------------------------------------------------------
@@ -370,7 +558,32 @@ class SlotServingEngine(ServingEngine):
         return [s for s in self._slots if s is not None]
 
     def pending(self) -> bool:
-        return bool(self._queue) or any(s is not None for s in self._slots)
+        return (
+            bool(self._queue)
+            or self._admitting is not None
+            or any(s is not None for s in self._slots)
+        )
+
+    def _free_slot(self) -> Optional[int]:
+        """Lowest unoccupied slot index, excluding the one reserved by an
+        in-flight chunked admission."""
+        reserved = self._admitting.slot if self._admitting is not None else -1
+        for i, s in enumerate(self._slots):
+            if s is None and i != reserved:
+                return i
+        return None
+
+    def _chunk_eligible(self, req: ServeRequest) -> bool:
+        """True when this request should be admitted chunk-by-chunk: chunked
+        prefill is configured and the prompt's prefix spans more than one
+        chunk (shorter prefixes gain nothing over the single-call bucket
+        prefill, which stays the fast path for them)."""
+        if self.prefill_chunk is None:
+            return False
+        cfg = req.config
+        bucket_len = self._pick_prompt_bucket(int(req.prompt.size), cfg)
+        prefix_len = int(req.prompt.size) - min(bucket_len, cfg.num_latents)
+        return prefix_len > self.prefill_chunk
 
     def _admit(self, req: ServeRequest, slot: int) -> None:
         cfg = req.config
@@ -410,6 +623,108 @@ class SlotServingEngine(ServingEngine):
                 bucket=bucket_len, prefill_ms=round(prefill_ms, 3),
             )
 
+    def _start_chunked_admit(self, req: ServeRequest, slot: int) -> None:
+        """Begin a chunked admission into ``slot``: build the row's window
+        and chunk schedule host-side, allocate the batch-1 staging caches,
+        and run the first chunk call (queue wait ends here — the bucket
+        engine's prefill-starts convention). Subsequent chunks advance one
+        per ``step()`` until the final call inserts the finished row."""
+        cfg = req.config
+        n = self.model.max_seq_len
+        L = int(req.prompt.size)
+        bucket_len = self._pick_prompt_bucket(L, cfg)
+        m0 = min(bucket_len, cfg.num_latents)
+        window = np.full((1, n), cfg.pad_token_id, np.int32)
+        window[0, n - L:] = req.prompt
+        by_index = np.full((n,), cfg.pad_token_id, np.int32)
+        by_index[:L] = req.prompt
+        C = self.prefill_chunk
+        # chunk starts cover the prefix token indices [0, L - m0); starts
+        # are clamped so a fixed-size chunk never runs past the cache (an
+        # overrunning chunk re-covers earlier positions with identical
+        # values, and latent/future positions it grazes are overwritten by
+        # the finalize / masked by length)
+        offsets = [min(o, n - C) for o in range(0, max(L - m0, 1), C)]
+        _, cache_s = _prefill_shapes(self.model, self.params)
+        t0 = self._clock()
+        req.started_at = t0
+        self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
+        self._admitting = _ChunkedAdmit(
+            req=req, slot=slot, bucket_len=bucket_len, m0=m0,
+            window=window, pad=np.asarray([n - L], np.int32),
+            by_index=by_index, offsets=offsets,
+            stage_k=jnp.zeros(cache_s["cross_k"].shape, cache_s["cross_k"].dtype),
+            stage_v=jnp.zeros(cache_s["cross_v"].shape, cache_s["cross_v"].dtype),
+        )
+        self._advance_chunked_admit()
+
+    def _advance_chunked_admit(self) -> None:
+        """Run the in-flight admission's next call: one staging chunk per
+        ``step()``, then a pure finalize call (latent k/v + attend + stack,
+        row inserted into the slot state). The finalize is its own call —
+        not folded into the last chunk — so the admission's worst per-step
+        stall is max(one chunk, one finalize), each well under the one-shot
+        prefill."""
+        admit = self._admitting
+        req = admit.req
+        C = self.prefill_chunk
+        i = admit.next_chunk
+        final = i == len(admit.offsets)
+        # the finalize branch ignores tokens/offset; reuse the first chunk's
+        # slice so the call signature stays uniform
+        off = 0 if final else admit.offsets[i]
+        tokens = jnp.asarray(admit.by_index[off:off + C][None, :])
+        executor = self._chunked_prefill_executor()
+        t0 = self._clock()
+        admit.stage_k, admit.stage_v, self._state = executor(
+            self.params, tokens, np.int32(off), np.bool_(final),
+            jnp.asarray(admit.window), jnp.asarray(admit.pad),
+            np.int32(admit.m0), np.int32(admit.slot),
+            admit.stage_k, admit.stage_v, self._state,
+        )
+        # fence the call (host value fetch — same sync discipline as the
+        # bucket prefill path) so the chunk/stall histograms are real
+        if final:
+            np.asarray(self._state["length"])
+        else:
+            np.asarray(admit.stage_k[0, 0, 0, 0])
+        chunk_ms = (self._clock() - t0) * 1e3
+        admit.device_ms += chunk_ms
+        admit.next_chunk += 1
+        # the ms histogram covers every call (the finalize's stall is part of
+        # the max(chunk, finalize) bound); the chunk counter covers staging
+        # calls only, so it totals the per-admission serving_prefill_chunks
+        self.registry.observe("serving_prefill_chunk_ms", chunk_ms)
+        if not final:
+            self.registry.inc("serving_prefill_chunks_total")
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.prefill_chunk", trace_id=req.trace_id, slot=admit.slot,
+                chunk=i, offset=off, final=final, ms=round(chunk_ms, 3),
+            )
+        if final:
+            self._admitting = None
+            self.registry.observe("serving_prefill_ms", admit.device_ms)
+            self.registry.observe("serving_prefill_chunks", len(admit.offsets))
+            self.registry.inc("serving_prefills_total")
+            self.registry.inc(
+                "serving_prompt_tokens_real_total", int(req.prompt.size)
+            )
+            self.registry.inc(
+                "serving_prompt_tokens_padded_total", admit.bucket_len
+            )
+            self._slots[admit.slot] = _Slot(
+                req=req, slot=admit.slot, max_new=req.config.max_new_tokens,
+                m=admit.m0,
+            )
+            if self.tracer is not None:
+                self.tracer.event(
+                    "serving.slot_assigned", trace_id=req.trace_id,
+                    slot=admit.slot, bucket=admit.bucket_len,
+                    prefill_ms=round(admit.device_ms, 3),
+                    chunks=len(admit.offsets),
+                )
+
     def _retire(self, entry: _Slot, status: str, *, error: Optional[str] = None) -> None:
         if status == "ok":
             pad_id = entry.req.config.pad_token_id
@@ -439,9 +754,10 @@ class SlotServingEngine(ServingEngine):
 
     # -- the token-level scheduler ------------------------------------------
     def step(self) -> int:
-        """Advance serving by ONE TOKEN: expire deadlines (queued and
-        resident), refill free slots from the queue, run one fixed-shape
-        decode step over all slots, and retire rows that just finished
+        """Advance serving by ONE TOKEN: expire deadlines (queued, resident,
+        and mid-admission), advance an in-flight chunked admission by one
+        chunk, refill free slots from the queue, run one fixed-shape decode
+        step over all slots, and retire rows that just finished
         (EOS / max_new_tokens). Returns the number of requests disposed of
         this call; ``pending()`` — not the return value — says whether more
         work remains (a mid-generation step legitimately disposes of 0).
@@ -457,12 +773,70 @@ class SlotServingEngine(ServingEngine):
                           f"{entry.max_new} tokens",
                 )
                 disposed += 1
-        while self._queue and None in self._slots:
+        ran_chunk_call = False
+        if self._admitting is not None:
+            admit = self._admitting
+            req = admit.req
+            if req.deadline_at is not None and now >= req.deadline_at:
+                self._admitting = None
+                self._finish(
+                    req, "timed_out",
+                    error=f"deadline exceeded after {admit.next_chunk} of "
+                          f"{len(admit.offsets)} prefill chunks",
+                )
+                disposed += 1
+            else:
+                final = admit.next_chunk == len(admit.offsets)
+                ran_chunk_call = True
+                try:
+                    self._advance_chunked_admit()
+                except Exception as e:
+                    # on CPU a chunk fault only poisons the batch-1 staging
+                    # caches; with donation live (non-CPU) the shared slot
+                    # state was donated into the failed call too, and a
+                    # finalize fault wrote into it on every backend
+                    self._admitting = None
+                    self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
+                    disposed += 1
+                    if final or _donate(0):
+                        return disposed + self._fail_resident(
+                            "chunked-prefill fault poisoned the slot state: "
+                            f"{type(e).__name__}: {e}"
+                        )
+        while self._queue:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            head = self._queue[0]
+            try:
+                chunked = self._chunk_eligible(head)
+            except Exception:
+                chunked = False  # infeasible heads fail in _admit as before
+            if chunked and (self._admitting is not None or ran_chunk_call):
+                # FIFO: the head needs the chunked-admit lane, which is
+                # either busy or already ran its one call this step (a
+                # finalize->first-chunk handoff in one step would stall
+                # residents past the documented max(chunk, finalize) bound)
+                break
             req = self._queue.pop(0)
             if self._apply_request_chaos(req):
                 disposed += 1
                 continue
-            slot = self._slots.index(None)
+            if chunked:
+                try:
+                    self._start_chunked_admit(req, slot)
+                except Exception as e:
+                    # first chunk: staging-only fault on CPU; with donation
+                    # live the slot state went into the failed call too
+                    self._admitting = None
+                    self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
+                    disposed += 1
+                    if _donate(0):
+                        return disposed + self._fail_resident(
+                            "chunked-prefill fault poisoned the slot state: "
+                            f"{type(e).__name__}: {e}"
+                        )
+                continue
             try:
                 self._admit(req, slot)
             except Exception as e:  # prefill fault: this request + residents
@@ -515,10 +889,19 @@ class SlotServingEngine(ServingEngine):
     # -- ahead-of-time warmup ------------------------------------------------
     def warmup(self, config: Optional[GenerationConfig] = None) -> int:
         """Compile every executor the engine can ever dispatch — one prefill
-        per feasible prompt bucket, the decode executor, and its boundary
-        variant — then wipe the warmup garbage from the slot state. Returns
-        the number of fresh executor builds; after it, mixed-length traffic
-        compiles nothing (pinned by tests)."""
+        per feasible prompt bucket, the decode executor, its boundary
+        variant, and (when ``prefill_chunk`` is set) the one chunked-prefill
+        executor — then wipe the warmup garbage from the slot state.
+        Returns the number of fresh executor builds; after it, mixed-length
+        traffic compiles nothing (pinned by tests).
+
+        When ``decode_strategy="auto"`` was requested explicitly, the
+        boundary autotuner runs first
+        (:func:`~perceiver_io_tpu.inference.decode_strategy.autotune_boundary`
+        — its cached-vs-recompute probe compiles two small generation
+        executors, counted in the return value), so the boundary variant is
+        compiled against the measured winner and steady-state traffic never
+        retraces."""
         if config is not None and dataclasses.replace(
             config, max_new_tokens=self.config.max_new_tokens
         ) != self.config:
@@ -526,7 +909,7 @@ class SlotServingEngine(ServingEngine):
                 "slot engine warmup config must match the engine config "
                 "(only max_new_tokens may differ)"
             )
-        if any(s is not None for s in self._slots):
+        if any(s is not None for s in self._slots) or self._admitting is not None:
             # warmup ends by blanking the device state; doing that under
             # resident requests would silently decode them from zeroed caches
             raise RuntimeError(
@@ -535,6 +918,11 @@ class SlotServingEngine(ServingEngine):
             )
         cfg = self.config
         before = executor_cache_stats()["misses"]
+        if self.decode_strategy == "auto":
+            decode_strategy_mod.autotune_boundary(self.model, self.params)
+        # no residents here (checked above), so re-resolving is safe: the
+        # boundary variant compiles against the freshest verdict
+        self._pinned_boundary_mode = None
         max_prefix = self.model.max_prefix_len
         for bucket_len in self.table.prompt_lens:
             if bucket_len - min(bucket_len, cfg.num_latents) > max_prefix:
@@ -544,6 +932,21 @@ class SlotServingEngine(ServingEngine):
             self._state = self._prefill_executor(bucket_len)(
                 self.params, ids, pad, np.int32(0), self._state
             )
+        if self.prefill_chunk is not None:
+            n = self.model.max_seq_len
+            _, cache_s = _prefill_shapes(self.model, self.params)
+            sk = jnp.zeros(cache_s["cross_k"].shape, cache_s["cross_k"].dtype)
+            sv = jnp.zeros(cache_s["cross_v"].shape, cache_s["cross_v"].dtype)
+            tokens = jnp.full((1, self.prefill_chunk), cfg.pad_token_id, jnp.int32)
+            window = jnp.full((1, n), cfg.pad_token_id, jnp.int32)
+            pad = jnp.zeros((1,), jnp.int32)
+            m0 = np.int32(min(cfg.num_latents, self.model.max_latents))
+            executor = self._chunked_prefill_executor()
+            for final in (False, True):  # one program: lax.cond traces both
+                sk, sv, self._state = executor(
+                    self.params, tokens, np.int32(0), np.bool_(final),
+                    window, pad, m0, np.int32(0), sk, sv, self._state,
+                )
         for boundary in (False, True):
             self._rng, key = jax.random.split(self._rng)
             self._state, _ = self._decode_executor(boundary)(
@@ -573,6 +976,13 @@ class SlotServingEngine(ServingEngine):
                 "p50": _round_ms(reg.percentile("serving_decode_step_ms", 50.0)),
                 "p95": _round_ms(reg.percentile("serving_decode_step_ms", 95.0)),
             },
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": int(counts.get("serving_prefill_chunks_total", 0)),
+            "prefill_chunk_ms": {
+                "p50": _round_ms(reg.percentile("serving_prefill_chunk_ms", 50.0)),
+                "p95": _round_ms(reg.percentile("serving_prefill_chunk_ms", 95.0)),
+            },
+            "decode_strategy_boundary": self._boundary_mode(),
         })
         return out
 
@@ -580,4 +990,5 @@ class SlotServingEngine(ServingEngine):
         out = super().health()
         out["slots"] = self.slots
         out["slots_active"] = sum(1 for s in self._slots if s is not None)
+        out["admitting"] = self._admitting is not None
         return out

@@ -45,6 +45,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "decode_strategy: per-phase decode-strategy + chunked-prefill test "
+        "(inference/decode_strategy.py, serving/slots.py; docs/serving.md); "
+        "CPU-fast, runs in the tier-1 suite with a per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )
