@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos obs decode-strategy decode-tune cov bench serve-bench dryrun lint
+.PHONY: test test-fast chaos obs obs-report decode-strategy decode-tune cov bench serve-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -18,6 +18,13 @@ chaos:
 # also included in the tier-1 "not slow" run
 obs:
 	$(PY) -m pytest tests/ -q -m observability --continue-on-collection-errors
+
+# offline `obs report` analyzer over the checked-in fixture artifacts
+# (docs/observability.md): per-phase latency, worst-request waterfall,
+# compile/memory ledger table, padding waste — no dashboard, no live run
+obs-report:
+	$(PY) -m perceiver_io_tpu.observability.report tests/fixtures/events.jsonl \
+		--snapshot tests/fixtures/metrics_snapshot.json
 
 # decode-strategy suite (per-phase cached-vs-recompute + chunked prefill;
 # docs/serving.md, docs/benchmarks.md) — CPU-fast, also tier-1

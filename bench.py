@@ -7,7 +7,10 @@ histograms, goodput, MFU gauges; docs/observability.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
 secondary metrics under "extras"; the record also carries the process-wide
-registry snapshot (``metrics_snapshot``) so BENCH_* files ship telemetry.
+registry snapshot (``metrics_snapshot``) and the device-cost ledger
+(``compile_ledger``: per-executor compile time, XLA cost/memory analysis,
+retrace attribution — docs/observability.md) so BENCH_* files ship
+telemetry and are ``obs report``-able offline.
 
 The reference publishes no throughput numbers (BASELINE.md), so the baseline
 is the north star from BASELINE.json: **0.8× an A100 on the same step**. The
@@ -565,12 +568,17 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "observability": {
                     "error": f"{type(e).__name__}: {e}"}})
 
-        # BENCH_* records carry the process-wide telemetry snapshot from now
-        # on (executor-cache counters etc.; docs/observability.md).
+        # BENCH_* records carry the process-wide telemetry snapshot AND the
+        # device-cost ledger (per-executor compile/memory/retrace table;
+        # docs/observability.md) — every BENCH_* file is `obs report`-able.
         try:
-            from perceiver_io_tpu.observability import default_registry
+            from perceiver_io_tpu.observability import default_ledger, default_registry
 
-            res.update(metrics_snapshot=default_registry().snapshot())
+            default_ledger().update_device_gauges()  # hbm_bytes_in_use on TPU
+            res.update(
+                metrics_snapshot=default_registry().snapshot(),
+                compile_ledger=default_ledger().snapshot(),
+            )
         except Exception as e:
             log(f"run: metrics snapshot skipped ({type(e).__name__}: {e})")
 

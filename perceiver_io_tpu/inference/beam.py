@@ -31,6 +31,7 @@ reindexing is a gather, and per-beam token histories live in a carried
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -99,7 +100,11 @@ def _beam_executor(
     """Compile-once beam program per static plan (same rationale and keying
     as ``generate._generation_executor`` — the eager body re-traced the
     whole scan on every call)."""
-    from perceiver_io_tpu.inference.generate import cached_executor, model_fingerprint
+    from perceiver_io_tpu.inference.generate import (
+        cached_executor,
+        ledger_model_id,
+        model_fingerprint,
+    )
     from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
 
     key = (
@@ -114,6 +119,22 @@ def _beam_executor(
             length_penalty, ids_dtype,
         ),
         max_entries=32,
+        ledger_site="beam",
+        ledger_components=lambda: {
+            "model": ledger_model_id(model),
+            # max_new_tokens is routine per-request variation — it belongs
+            # to beam_plan (the compiled scan length), not the `config`
+            # retrace reason (sampling/eos/latents; docs/observability.md)
+            "config": dataclasses.replace(config, max_new_tokens=0),
+            "bucket_shape": f"{b}x{prompt_len}",
+            "num_latents": num_latents,
+            "beam_plan": (
+                f"k={num_beams},lp={length_penalty},"
+                f"steps={config.max_new_tokens}"
+            ),
+            "ids_dtype": ids_dtype,
+            "trace_env": trace_env_fingerprint(),
+        },
     )
 
 

@@ -54,6 +54,7 @@ in a single chained-scan program):
 """
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
@@ -690,6 +691,18 @@ def model_fingerprint(model) -> str:
     return fingerprint
 
 
+def ledger_model_id(model) -> str:
+    """Short stable identity for ledger components: the architecture
+    fingerprint is a whole module-tree repr — far too long to display in a
+    compile table or diff line — so components carry its hash. Two models
+    share an ID iff they share a fingerprint (the same equivalence the
+    executor cache keys use)."""
+    import hashlib
+
+    digest = hashlib.md5(model_fingerprint(model).encode()).hexdigest()[:10]
+    return f"{type(model).__qualname__}:{digest}"
+
+
 #: Process-wide hit/miss/evict counters across ALL executor caches (the
 #: generation cache here and the beam cache in ``beam.py``). A miss means a
 #: fresh trace+compile (~1.5 s at test scale) — the serving layer reads these
@@ -739,20 +752,38 @@ def reset_executor_caches() -> None:
     serving-warmup measurement hook). Rewinding the global counters makes
     live ``ServingEngine`` instances' construction-time snapshots stale —
     their ``stats()`` deltas clamp at 0 rather than going negative, but
-    create engines after the reset when exact counts matter."""
+    create engines after the reset when exact counts matter. The compile
+    ledger's records and identity history reset too: the builds they
+    describe no longer exist, and a post-reset rebuild is a cold compile,
+    not a retrace of a dropped executor."""
     from perceiver_io_tpu.inference import beam
-    from perceiver_io_tpu.observability import default_registry
+    from perceiver_io_tpu.observability import default_ledger, default_registry
 
     _EXECUTOR_CACHE.clear()
     beam._EXECUTOR_CACHE.clear()
     for cache in _EXTRA_CACHES:
         cache.clear()
     default_registry().reset("executor_cache_")
+    default_registry().reset("compile_")
+    default_registry().reset("retrace_")
+    default_ledger().reset()
 
 
-def cached_executor(cache: dict, key, build, *, max_entries: int = 64):
-    """FIFO-bounded compile-once cache shared by the generation and beam
-    executors: ``build()`` is called (and jitted) only on a key miss."""
+def cached_executor(cache: dict, key, build, *, max_entries: int = 64,
+                    ledger_site: Optional[str] = None,
+                    ledger_components: Optional[dict] = None):
+    """FIFO-bounded compile-once cache shared by the generation, beam, and
+    slot executors: ``build()`` is called (and jitted) only on a key miss.
+
+    ``ledger_site``/``ledger_components`` opt the fresh build into the
+    device-cost ledger (``observability/ledger.py``): the executor is
+    wrapped so its first call is AOT-compiled, timed, and cost/memory-
+    analyzed under ``ledger_site``, with the NAMED ``ledger_components``
+    diffed against the previous build of the same (site, model) identity
+    for retrace attribution. Pass ``ledger_components`` as a ZERO-ARG
+    CALLABLE: component assembly (model-id hashing, config normalization)
+    is miss-only work, and every caller sits on a per-dispatch hot path
+    where the cache hits."""
     from perceiver_io_tpu.observability import default_registry
 
     reg = default_registry()
@@ -762,6 +793,16 @@ def cached_executor(cache: dict, key, build, *, max_entries: int = 64):
         return cached
     reg.inc("executor_cache_misses_total")
     executor = build()
+    if ledger_site is not None:
+        from perceiver_io_tpu.observability import default_ledger
+
+        components = (
+            ledger_components() if callable(ledger_components)
+            else (ledger_components or {})
+        )
+        executor = default_ledger().wrap(
+            executor, site=ledger_site, components=components
+        )
     if len(cache) >= max_entries:
         cache.pop(next(iter(cache)))
         reg.inc("executor_cache_evictions_total")
@@ -798,6 +839,19 @@ def _generation_executor(
         lambda: _build_generation_executor(
             model, config, b, prompt_len, num_latents, s1, s2, ids_dtype
         ),
+        ledger_site="generate",
+        ledger_components=lambda: {
+            "model": ledger_model_id(model),
+            # max_new_tokens is routine per-request variation already
+            # captured by phase_plan (s2 is the compiled scan length);
+            # `config` means sampling/eos/latents (docs/observability.md)
+            "config": dataclasses.replace(config, max_new_tokens=0),
+            "bucket_shape": f"{b}x{prompt_len}",
+            "num_latents": num_latents,
+            "phase_plan": f"s1={s1},s2={s2}",
+            "ids_dtype": ids_dtype,
+            "trace_env": trace_env_fingerprint(),
+        },
     )
 
 

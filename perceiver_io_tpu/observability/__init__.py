@@ -18,7 +18,14 @@ work; this is that instrumentation spine:
 - :func:`to_prometheus_text` / :func:`snapshot_json` /
   :class:`SnapshotWriter` — one registry, two export formats.
 - :class:`ProfilerTrigger` — arms a ``jax.profiler`` capture of the next
-  step when the step-time p95 regresses.
+  step when the step-time p95 regresses (trainer loop AND the serving
+  decode path).
+- :class:`CompileLedger` / :func:`default_ledger` — the device-cost
+  ledger: per-executor compile wall time, XLA cost/memory analysis, and
+  retrace attribution over named cache-key components
+  (:mod:`~perceiver_io_tpu.observability.ledger`).
+- :mod:`~perceiver_io_tpu.observability.report` — the offline ``obs
+  report`` analyzer over ``events.jsonl`` + snapshot.
 - :mod:`~perceiver_io_tpu.observability.compat` — the metrics.jsonl
   schema-migration reader.
 
@@ -33,9 +40,16 @@ from typing import Optional
 
 from perceiver_io_tpu.observability.compat import normalize_row, read_metrics_jsonl
 from perceiver_io_tpu.observability.exporters import (
+    HELP_TEXT,
     SnapshotWriter,
+    help_text,
     snapshot_json,
     to_prometheus_text,
+)
+from perceiver_io_tpu.observability.ledger import (
+    CompileLedger,
+    LedgeredExecutor,
+    default_ledger,
 )
 from perceiver_io_tpu.observability.registry import (
     Histogram,
@@ -68,20 +82,28 @@ class ObservabilityArgs:
     #: snapshot destination; defaults next to the events/metrics files
     snapshot_path: Optional[str] = None
     #: arm a jax.profiler capture of the next step when the step-time p95
-    #: exceeds this factor × the warmed-up baseline p95 (None disables)
+    #: exceeds this factor × the warmed-up baseline p95 (None disables).
+    #: ``fit`` watches trainer step times; ``serve`` watches the decode
+    #: path (slot-engine ``serving_decode_step_ms`` / bucket-engine
+    #: ``serving_device_execute_ms``) and captures the next dispatch
     profile_on_regress_factor: Optional[float] = None
 
 
 __all__ = [
+    "CompileLedger",
+    "HELP_TEXT",
     "Histogram",
     "JsonlSpanSink",
+    "LedgeredExecutor",
     "MetricsRegistry",
     "ObservabilityArgs",
     "ProfilerTrigger",
     "SnapshotWriter",
     "Span",
     "Tracer",
+    "default_ledger",
     "default_registry",
+    "help_text",
     "normalize_row",
     "read_events_jsonl",
     "read_metrics_jsonl",

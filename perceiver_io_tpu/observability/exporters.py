@@ -30,6 +30,75 @@ from perceiver_io_tpu.observability.registry import MetricsRegistry
 
 _QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
 
+#: one-line human descriptions for the canonical metric families
+#: (docs/observability.md) — rendered as ``# HELP`` lines in the
+#: exposition so a scrape endpoint is self-describing
+HELP_TEXT = {
+    "serving_requests_submitted_total": "Requests accepted into the serving queue.",
+    "serving_requests_completed_total": "Requests that finished with a generated result.",
+    "serving_requests_shed_total": "Submissions rejected by bounded-queue backpressure.",
+    "serving_requests_timed_out_total": "Requests whose deadline expired before completion.",
+    "serving_requests_failed_total": "Requests failed by an executor or injected fault.",
+    "serving_requests_rejected_total": "Submissions rejected as infeasible (empty / over the largest bucket).",
+    "serving_batches_total": "Micro-batches executed by the bucket engine.",
+    "serving_tokens_generated_total": "Real (non-filler) tokens generated across requests.",
+    "serving_prompt_tokens_real_total": "Prompt tokens submitted by callers.",
+    "serving_prompt_tokens_padded_total": "Prompt tokens after bucket padding (real + pad).",
+    "serving_decode_rows_total": "Decode-step rows executed (real + filler).",
+    "serving_decode_rows_padded_total": "Decode-step rows that were padding filler.",
+    "serving_decode_steps_total": "Fixed-shape slot decode steps executed.",
+    "serving_prefills_total": "Slot admissions prefilled (single-call or chunked).",
+    "serving_prefill_chunks_total": "Chunked-prefill staging calls executed.",
+    "serving_queue_wait_ms": "Queue wait per request: submit to batch/prefill start.",
+    "serving_batch_assembly_ms": "Host-side micro-batch packing time.",
+    "serving_device_execute_ms": "Device execute time per micro-batch (dispatch + fence).",
+    "serving_request_latency_ms": "End-to-end request latency: submit to terminal state.",
+    "serving_decode_step_ms": "One fixed-shape slot decode step (dispatch + fence).",
+    "serving_prefill_ms": "Per-admission prefill time (summed chunks when chunked).",
+    "serving_prefill_chunk_ms": "Per-call chunked-prefill stall (staging or finalize).",
+    "serving_prefill_chunks": "Staging chunks per chunked admission.",
+    "serving_slots_active": "Slots holding a resident request right now.",
+    "serving_slots_idle": "Slots free for admission right now.",
+    "serving_throughput_tokens_per_sec": "Serving throughput gauge (bench probe).",
+    "serving_goodput_ratio": "Completed / offered requests (bench probe).",
+    "serving_mfu": "Serving model-FLOPs utilization gauge (bench probe).",
+    "executor_cache_hits_total": "Executor-cache hits (no trace, no compile).",
+    "executor_cache_misses_total": "Executor-cache misses (a fresh trace + compile).",
+    "executor_cache_evictions_total": "Executors dropped by the FIFO cache bound.",
+    "compile_total": "Executor builds recorded by the compile ledger.",
+    "compile_ms": "Per-executor trace + XLA compile wall time.",
+    "retrace_total": "Rebuilds of a logically-same executor (see retrace_reason_*).",
+    "compile_ledger_fallback_total": "Executors demoted from AOT ledger dispatch to plain jit.",
+    "hbm_bytes_in_use": "Live device memory from memory_stats() (absent on CPU).",
+    "kv_cache_resident_bytes": "Analytic byte size of the persistent slot KV caches.",
+    "executor_resident_bytes": "Sum of recorded executors' temp+output bytes (XLA memory analysis).",
+    "trainer_steps_total": "Executed optimizer steps (skipped steps included).",
+    "trainer_skipped_steps_total": "Steps discarded by the non-finite skip policy.",
+    "trainer_rollbacks_total": "Divergence rollbacks to a saved training state.",
+    "trainer_callback_errors_total": "Callbacks that raised and were isolated.",
+    "trainer_step_dispatch_ms": "Host dispatch time per step (unfenced; device async).",
+    "trainer_step_ms": "Fenced true step time (profiler-trigger runs only).",
+    "trainer_steps_per_sec": "Recent steady-state training step rate.",
+    "trainer_loss": "Most recently logged training loss.",
+}
+
+#: prefix-matched fallbacks for generated families (per-reason counters,
+#: StepTimer gauges) — first hit wins
+_HELP_PREFIXES = (
+    ("retrace_reason_", "Retraces attributed to this changed cache-key component."),
+)
+
+
+def help_text(name: str) -> Optional[str]:
+    """Human description for a canonical family, or None for ad-hoc names."""
+    known = HELP_TEXT.get(name)
+    if known is not None:
+        return known
+    for prefix, text in _HELP_PREFIXES:
+        if name.startswith(prefix):
+            return text
+    return None
+
 
 def _sanitize(name: str) -> str:
     """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
@@ -49,20 +118,29 @@ def _num(value: float) -> str:
 
 def to_prometheus_text(registry: MetricsRegistry) -> str:
     """Render the registry in Prometheus exposition format (counters,
-    gauges, histogram summaries), sorted by name for stable diffs."""
+    gauges, histogram summaries), sorted by name for stable diffs. Every
+    canonical family gets a ``# HELP`` line (:data:`HELP_TEXT`); ad-hoc
+    names render with ``# TYPE`` only."""
     snap = registry.snapshot()
     lines = []
+
+    def _header(name: str, metric: str, kind: str) -> None:
+        desc = help_text(name)
+        if desc is not None:
+            lines.append(f"# HELP {metric} {desc}")
+        lines.append(f"# TYPE {metric} {kind}")
+
     for name, value in sorted(snap["counters"].items()):
         metric = _sanitize(name)
-        lines.append(f"# TYPE {metric} counter")
+        _header(name, metric, "counter")
         lines.append(f"{metric} {_num(value)}")
     for name, value in sorted(snap["gauges"].items()):
         metric = _sanitize(name)
-        lines.append(f"# TYPE {metric} gauge")
+        _header(name, metric, "gauge")
         lines.append(f"{metric} {_num(value)}")
     for name, summ in sorted(snap["histograms"].items()):
         metric = _sanitize(name)
-        lines.append(f"# TYPE {metric} summary")
+        _header(name, metric, "summary")
         for q, key in _QUANTILES:
             if summ[key] is not None:
                 lines.append(f'{metric}{{quantile="{q}"}} {_num(summ[key])}')
@@ -71,8 +149,15 @@ def to_prometheus_text(registry: MetricsRegistry) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
-def snapshot_json(registry: MetricsRegistry, *, indent: Optional[int] = None) -> str:
-    return json.dumps(registry.snapshot(), indent=indent, sort_keys=True)
+def snapshot_json(registry: MetricsRegistry, *, indent: Optional[int] = None,
+                  extra: Optional[dict] = None) -> str:
+    """Registry snapshot as JSON; ``extra`` keys are merged at the top level
+    (the serve CLI embeds the compile ledger's table this way, so an offline
+    ``obs report`` over the snapshot sees the per-executor costs)."""
+    snap = registry.snapshot()
+    if extra:
+        snap.update(extra)
+    return json.dumps(snap, indent=indent, sort_keys=True)
 
 
 class SnapshotWriter:
@@ -82,15 +167,21 @@ class SnapshotWriter:
     :param every_s: minimum seconds between writes; None = only explicit
         ``maybe_write(force=True)`` calls write.
     :param clock: injectable time source (FakeClock in tests).
+    :param extra: optional zero-arg callable whose dict result is merged
+        into every written snapshot (e.g. ``lambda: {"compile_ledger":
+        default_ledger().snapshot()}``); a raising ``extra`` is dropped for
+        that write, never fatal.
     """
 
     def __init__(self, registry: MetricsRegistry, path: str,
                  *, every_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 extra: Optional[Callable[[], dict]] = None):
         self.registry = registry
         self.path = path
         self.every_s = every_s
         self._clock = clock
+        self._extra = extra
         self._last_write: Optional[float] = None
         self.writes = 0
         self.write_errors = 0
@@ -112,10 +203,16 @@ class SnapshotWriter:
         )
         if not (force or due):
             return False
+        extra = None
+        if self._extra is not None:
+            try:
+                extra = self._extra()
+            except Exception:
+                extra = None  # telemetry enrichment must not block the write
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w") as fh:
-                fh.write(snapshot_json(self.registry, indent=2))
+                fh.write(snapshot_json(self.registry, indent=2, extra=extra))
             os.replace(tmp, self.path)
         except OSError:
             self.write_errors += 1

@@ -1,0 +1,412 @@
+"""Device-cost ledger: compile / memory / retrace attribution per executor.
+
+The telemetry spine (registry, spans, exporters) sees only the host side:
+it can say a serve run spent 40 s before first traffic, but not *what* each
+executor cost to build, how many bytes it holds resident, or *why* a
+logically-same executor rebuilt. Both the Gemma-on-TPU serving comparison
+and the pjit/TPUv4 scalable-training paper (PAPERS.md) treat exactly that
+device-level attribution — compile time, HBM footprint, retrace cause — as
+prerequisites for capacity planning, and the ROADMAP's paged-KV and
+sharded-serving items are bounded by compile count and KV memory today.
+
+:class:`CompileLedger` is that attribution layer. Every executor build site
+(``inference/generate.py`` generation executors — which the bucket engine's
+warmup drives — ``inference/beam.py``, and the slot engine's
+prefill/decode/boundary/chunk executors in ``serving/slots.py``) routes
+through :func:`~perceiver_io_tpu.inference.generate.cached_executor`, which
+hands each fresh build to :meth:`CompileLedger.wrap`. The wrapper AOT-lowers
+and compiles the program on its first call (``jit(f).lower().compile()`` —
+the same trace+compile work the first jit dispatch would do, paid once) and
+records, per cache key:
+
+- **compile wall time** (trace + XLA compile, measured on the ledger clock);
+- **cost analysis** — lowered FLOPs and bytes-accessed from XLA's
+  ``compiled.cost_analysis()``;
+- **memory analysis** — argument / output / temp / generated-code bytes
+  from ``compiled.memory_analysis()`` (the executor's resident HBM claim);
+- **retrace attribution** — when a logically-same executor (same site, same
+  model fingerprint) rebuilds, the named cache-key components are diffed
+  against the previous build and the rebuild is counted under every
+  component that changed (``bucket_shape``, ``trace_env``,
+  ``decode_strategy``, ``phase_plan``, ``config``, ...). The first build of
+  an identity is a cold compile, not a retrace.
+
+Registry families fed (docs/observability.md):
+
+- ``compile_total`` counter and ``compile_ms`` histogram;
+- ``retrace_total`` plus per-reason ``retrace_reason_<component>_total``;
+- ``executor_resident_bytes`` gauge (sum of live executors' temp+output
+  bytes — the analytic footprint XLA claims);
+- ``hbm_bytes_in_use`` gauge via :meth:`update_device_gauges` — device
+  ``memory_stats()`` where the backend provides it (TPU/GPU; CPU returns
+  None and the gauge is skipped);
+- ``kv_cache_resident_bytes`` gauge — the analytic slot-KV footprint the
+  slot engine publishes at construction (everywhere, device stats or not).
+
+Failure containment: observation must never change execution semantics. If
+AOT compile fails (a backend without AOT support) or the compiled dispatch
+rejects the call signature (``TypeError`` — AOT executables are
+shape/dtype/weak-type strict), the wrapper permanently falls back to the
+plain jitted callable for that executor and counts
+``compile_ledger_fallback_total`` — the run proceeds exactly as before the
+ledger existed, minus one row of attribution. Genuine *execution* errors
+(device OOM, XLA runtime failures) re-raise untouched: retrying a dispatch
+that may already have consumed donated buffers would mask the real
+failure.
+
+Determinism: with an injected clock (``reliability.FakeClock``) the ledger's
+records — ordering, sequence numbers, retrace reasons — are a pure function
+of the build sequence, pinned by ``tests/test_ledger.py``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from perceiver_io_tpu.observability.registry import MetricsRegistry
+
+
+def _sanitize_reason(name: str) -> str:
+    """Component name -> metric-name-safe reason token."""
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+class LedgeredExecutor:
+    """A jitted executor whose first call is AOT-lowered, compiled, timed,
+    and cost/memory-analyzed into the owning ledger; later calls dispatch
+    the compiled executable directly. Any AOT failure (lowering, analysis
+    mismatch, strict-signature drift) permanently falls back to the plain
+    jitted callable — observation never fails the computation."""
+
+    __slots__ = ("_fn", "_compiled", "_ledger", "_entry", "_fallback", "_lock")
+
+    def __init__(self, fn: Callable, ledger: "CompileLedger", entry: dict):
+        self._fn = fn
+        self._compiled = None
+        self._ledger = ledger
+        self._entry = entry
+        self._fallback = False
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        compiled = self._compiled
+        if compiled is None and not self._fallback:
+            with self._lock:  # one compiler, even under a scrape thread
+                if self._compiled is None and not self._fallback:
+                    self._aot_compile(*args, **kwargs)
+                compiled = self._compiled
+        if compiled is not None:  # local read: a concurrent demotion can't
+            try:                  # null the reference mid-dispatch
+                return compiled(*args, **kwargs)
+            except TypeError:
+                # strict AOT signature (no weak-type/shape promotion):
+                # demote to the jitted path rather than fail a request over
+                # telemetry. Anything else is a genuine execution error —
+                # re-raise rather than retry against possibly-donated
+                # buffers and mask the real failure. Demote under the lock,
+                # exactly once even when several threads hit the drift
+                # together, so AOT can't re-arm and the fallback counter
+                # counts demotions, not racers.
+                with self._lock:
+                    first = not self._fallback
+                    self._fallback = True
+                    self._compiled = None
+                if first:
+                    self._ledger._count_fallback(self._entry)
+        return self._fn(*args, **kwargs)
+
+    def _aot_compile(self, *args, **kwargs) -> None:
+        clock = self._ledger._clock
+        t0 = clock()
+        try:
+            compiled = self._fn.lower(*args, **kwargs).compile()
+        except Exception:
+            self._fallback = True
+            self._ledger._count_fallback(self._entry)
+            return
+        compile_ms = (clock() - t0) * 1e3
+        self._compiled = compiled
+        cost = _cost_summary(compiled)
+        memory = _memory_summary(compiled)
+        self._ledger._record_compiled(self._entry, compile_ms, cost, memory)
+
+
+def _cost_summary(compiled) -> Dict[str, Optional[float]]:
+    """``cost_analysis()`` across jax versions returns a dict or a 1-list of
+    dicts; normalize to {flops, bytes_accessed} (None when unavailable)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {"flops": None, "bytes_accessed": None}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {"flops": None, "bytes_accessed": None}
+    flops = ca.get("flops")
+    accessed = ca.get("bytes accessed")
+    return {
+        "flops": None if flops is None else float(flops),
+        "bytes_accessed": None if accessed is None else float(accessed),
+    }
+
+
+def _memory_summary(compiled) -> Dict[str, Optional[int]]:
+    """``memory_analysis()`` -> {argument,output,temp,generated_code}_bytes
+    (all None on backends that don't implement it)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    fields = (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+    )
+    if ma is None:
+        return {k: None for k, _ in fields}
+    out = {}
+    for key, attr in fields:
+        value = getattr(ma, attr, None)
+        out[key] = None if value is None else int(value)
+    return out
+
+
+class CompileLedger:
+    """Per-executor compile/memory/retrace ledger over one metrics registry.
+
+    :param registry: registry the canonical families land on; defaults to
+        the process-wide :func:`~perceiver_io_tpu.observability.default_registry`
+        (executor caches are process-global, so their ledger is too).
+    :param clock: monotonic time source for compile timing —
+        ``reliability.FakeClock`` makes records fully deterministic.
+    :param keep: bound on retained per-key records (FIFO; the registry
+        counters keep counting past it).
+    """
+
+    def __init__(self, *, registry: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 keep: int = 512):
+        if registry is None:
+            from perceiver_io_tpu.observability.registry import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self._clock = clock
+        self._keep = keep
+        self._lock = threading.Lock()
+        self._records: List[dict] = []
+        #: identity -> components of that identity's most recent build
+        self._last: Dict[tuple, Dict[str, str]] = {}
+        #: (site, components) -> latest build's temp+output bytes; kept
+        #: incrementally so the resident gauge costs O(1) per compile
+        #: (independent of the ``keep`` record bound)
+        self._resident: Dict[tuple, int] = {}
+        #: lifetime totals — unlike ``_records`` these never FIFO out, so
+        #: the rollup stays exact past the ``keep`` bound
+        self._total_retraces = 0
+        self._total_compile_ms = 0.0
+        self._reason_totals: Dict[str, int] = {}
+        self._seq = 0
+        self._on_record: List[Callable[[dict], None]] = []
+        registry.declare_counters(
+            "compile_total", "retrace_total", "compile_ledger_fallback_total"
+        )
+
+    # -- wiring ---------------------------------------------------------------
+    def wrap(self, executor: Callable, *, site: str,
+             components: Dict[str, Any]) -> Callable:
+        """Wrap one freshly built jitted executor for ledger accounting.
+
+        :param site: build-site name (``generate``, ``beam``,
+            ``slot_prefill``, ``slot_decode``, ``slot_prefill_chunk``).
+        :param components: the NAMED cache-key components — retrace
+            attribution diffs these, so every key-relevant knob must appear
+            (``model``, ``bucket_shape``, ``trace_env``, ...). Values are
+            stringified; ``model`` (or the whole dict) defines the identity
+            a rebuild is compared against.
+        """
+        comps = {k: str(v) for k, v in components.items()}
+        entry = {"site": site, "components": comps}
+        return LedgeredExecutor(executor, self, entry)
+
+    def attach(self, callback: Callable[[dict], None]) -> Callable[[], None]:
+        """Register a per-record callback (the serve CLI forwards records as
+        ``ledger.compile`` span events into events.jsonl); returns a detach
+        function. Callback exceptions are swallowed — the ledger must never
+        fail the build it observes."""
+        self._on_record.append(callback)
+
+        def detach() -> None:
+            try:
+                self._on_record.remove(callback)
+            except ValueError:
+                pass
+
+        return detach
+
+    # -- recording -------------------------------------------------------------
+    def _identity(self, site: str, components: Dict[str, str]) -> tuple:
+        """A rebuild is "logically the same executor" when site + model
+        match; everything else (bucket shape, phase plan, env fingerprint,
+        decode strategy) is a variant axis a retrace is attributed to."""
+        return (site, components.get("model", ""))
+
+    def _record_compiled(self, entry: dict, compile_ms: float,
+                         cost: Dict[str, Optional[float]],
+                         memory: Dict[str, Optional[int]]) -> None:
+        site, comps = entry["site"], entry["components"]
+        identity = self._identity(site, comps)
+        with self._lock:
+            self._seq += 1
+            prev = self._last.get(identity)
+            reasons: tuple = ()
+            if prev is not None:
+                changed = sorted(
+                    k for k in (set(prev) | set(comps))
+                    if prev.get(k) != comps.get(k)
+                )
+                reasons = tuple(changed) if changed else ("duplicate_key",)
+            self._last[identity] = comps
+            record = {
+                "seq": self._seq,
+                "site": site,
+                "components": dict(comps),
+                "compile_ms": round(compile_ms, 3),
+                "flops": cost["flops"],
+                "bytes_accessed": cost["bytes_accessed"],
+                **memory,
+                "retrace": prev is not None,
+                "retrace_reasons": list(reasons),
+            }
+            self._records.append(record)
+            if len(self._records) > self._keep:
+                self._records.pop(0)
+            self._total_compile_ms += compile_ms
+            if reasons:
+                self._total_retraces += 1
+                for reason in reasons:
+                    self._reason_totals[reason] = (
+                        self._reason_totals.get(reason, 0) + 1
+                    )
+            # one entry per distinct (site, components) executor — a
+            # rebuild of the same program replaces its bytes rather than
+            # accumulating (the ledger can't see cache evictions; evicted
+            # executors stay counted until reset)
+            self._resident[(site, tuple(sorted(comps.items())))] = (
+                (memory["temp_bytes"] or 0) + (memory["output_bytes"] or 0)
+            )
+            resident = sum(self._resident.values())
+        reg = self.registry
+        reg.inc("compile_total")
+        reg.observe("compile_ms", compile_ms)
+        if reasons:
+            reg.inc("retrace_total")
+            for reason in reasons:
+                reg.inc(f"retrace_reason_{_sanitize_reason(reason)}_total")
+        reg.set_gauge("executor_resident_bytes", resident)
+        for callback in list(self._on_record):
+            try:
+                callback(record)
+            except Exception:
+                pass
+
+    def _count_fallback(self, entry: Optional[dict] = None) -> None:
+        """Count a demotion; when the executor had recorded resident bytes
+        (post-compile strict-signature demotion frees the AOT executable),
+        drop them from the gauge — the plain-jit replacement is untracked."""
+        if entry is not None:
+            key = (entry["site"], tuple(sorted(entry["components"].items())))
+            with self._lock:
+                dropped = self._resident.pop(key, None)
+                resident = sum(self._resident.values())
+            if dropped is not None:
+                self.registry.set_gauge("executor_resident_bytes", resident)
+        self.registry.inc("compile_ledger_fallback_total")
+
+    # -- device gauges -----------------------------------------------------------
+    def update_device_gauges(self) -> Optional[int]:
+        """Publish ``hbm_bytes_in_use`` from the backend's live
+        ``memory_stats()`` (first device). Returns the bytes value, or None
+        on backends (CPU) that report nothing — the analytic gauges
+        (``kv_cache_resident_bytes``, ``executor_resident_bytes``) are the
+        everywhere-available fallback."""
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if not stats or "bytes_in_use" not in stats:
+            return None
+        value = int(stats["bytes_in_use"])
+        self.registry.set_gauge("hbm_bytes_in_use", value)
+        return value
+
+    def set_kv_cache_bytes(self, nbytes: int) -> None:
+        """Analytic KV-cache footprint gauge (the slot engine publishes its
+        persistent slot state's byte size — exact on every backend)."""
+        self.registry.set_gauge("kv_cache_resident_bytes", int(nbytes))
+
+    # -- introspection / export ---------------------------------------------------
+    def records(self, site: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            out = [dict(r) for r in self._records]
+        if site is not None:
+            out = [r for r in out if r["site"] == site]
+        return out
+
+    def rollup(self) -> dict:
+        """Records-free summary — counts, reasons, compile-time total. This
+        is what pollable surfaces (``ServingEngine.stats()``) embed: no
+        per-record dict copies on the scrape path. All values are LIFETIME
+        totals (matching the registry counters), not views over the
+        ``keep``-bounded record list."""
+        with self._lock:
+            rollup = {
+                "compiles": self._seq,
+                "retraces": self._total_retraces,
+                "retrace_reasons": dict(sorted(self._reason_totals.items())),
+                "compile_ms_total": round(self._total_compile_ms, 3),
+            }
+        rollup["fallbacks"] = int(
+            self.registry.counter("compile_ledger_fallback_total")
+        )
+        return rollup
+
+    def snapshot(self) -> dict:
+        """JSON-able ledger view: the lifetime rollup plus the per-key
+        compile/memory table every durable consumer (``serve_stats``,
+        snapshots, bench records, ``obs report``) embeds. The table is
+        bounded by ``keep`` (oldest rows FIFO out); the rollup keeps
+        counting past it."""
+        return {**self.rollup(), "records": self.records()}
+
+    def reset(self) -> None:
+        """Drop records and identity history (test isolation; registry
+        counters are reset separately via ``registry.reset``)."""
+        with self._lock:
+            self._records.clear()
+            self._last.clear()
+            self._resident.clear()
+            self._total_retraces = 0
+            self._total_compile_ms = 0.0
+            self._reason_totals.clear()
+            self._seq = 0
+        # the executors the gauge described are gone too
+        self.registry.set_gauge("executor_resident_bytes", 0)
+
+
+#: Process-wide default ledger, mirroring ``default_registry()``: the
+#: executor caches it observes are process-global singletons.
+_DEFAULT: Optional[CompileLedger] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_ledger() -> CompileLedger:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = CompileLedger()
+        return _DEFAULT

@@ -1,0 +1,375 @@
+"""``obs report`` — offline analyzer over ``events.jsonl`` + a metrics
+snapshot: the dashboard-less debugging path.
+
+A BENCH file or a production serve run leaves two artifacts behind —
+span events (``--obs.events_path``) and a registry snapshot
+(``--obs.snapshot_path``, ledger table included). This module turns them
+back into the four questions an operator asks first, with no dashboard,
+no scrape endpoint, and no live process:
+
+1. **Per-phase latency breakdown** — every span family's count, total,
+   p50/p95/max, so "where did the wall time go" reads off one table.
+2. **Worst-request waterfall** — the slowest terminal ``serving.request``
+   trace, with every span/event on that trace laid out by offset from
+   submit.
+3. **Compile/memory table** — the device-cost ledger's per-executor rows
+   (compile ms, FLOPs, bytes accessed, temp/output/argument bytes, retrace
+   reasons), read from the snapshot's ``compile_ledger`` or — when only
+   events exist — from the ``ledger.compile`` events the serve CLI
+   forwards.
+4. **Padding waste** — prompt-token and decode-row real-vs-padded ratios
+   from the snapshot counters.
+
+Percentiles are computed through the SAME
+:class:`~perceiver_io_tpu.observability.Histogram` the live registry uses
+(nearest-rank over the window), so the report's request-latency breakdown
+reproduces what ``stats()`` reported at record time — pinned by
+``tests/test_ledger.py``.
+
+Entry points: ``<family CLI> obs report --events events.jsonl
+[--snapshot snap.json]`` or ``python -m
+perceiver_io_tpu.observability.report events.jsonl --snapshot snap.json``
+(also behind ``make obs-report``). Stdlib-only: the analyzer must run
+where jax does not.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from perceiver_io_tpu.observability.registry import Histogram
+from perceiver_io_tpu.observability.tracing import read_events_jsonl
+
+
+def _percentiles(values: List[float]) -> dict:
+    """count/total/p50/p95/max via the registry's own Histogram at its
+    default window, so offline numbers match the live export's nearest-rank
+    convention — including the last-2048 sliding window on runs whose span
+    count exceeds it (events stream in observation order)."""
+    hist = Histogram()
+    for v in values:
+        hist.observe(v)
+    summ = hist.summary()
+    return {
+        "count": summ["count"],
+        "total_ms": summ["sum"],
+        "p50_ms": summ["p50"],
+        "p95_ms": summ["p95"],
+        "max_ms": summ["max"],
+    }
+
+
+def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
+    """Pure analysis over parsed events rows (+ optional snapshot dict);
+    returns the JSON-able report body ``format_report`` renders."""
+    snapshot = snapshot or {}
+    by_span: Dict[str, List[float]] = {}
+    for row in events:
+        if row.get("span") == "ledger.compile":
+            # a forwarded ledger record is a point event — its real cost is
+            # attrs.compile_ms, rendered in the compile table below; a
+            # 0-duration row here would contradict that table
+            continue
+        dur = row.get("duration_ms")
+        if isinstance(dur, (int, float)):
+            by_span.setdefault(row.get("span", "?"), []).append(float(dur))
+    phases = {name: _percentiles(vals) for name, vals in sorted(by_span.items())}
+
+    terminals = [r for r in events if r.get("span") == "serving.request"]
+    by_status: Dict[str, int] = {}
+    for r in terminals:
+        status = r.get("status", "?")
+        by_status[status] = by_status.get(status, 0) + 1
+    latencies = [
+        float(r["duration_ms"]) for r in terminals
+        if isinstance(r.get("duration_ms"), (int, float))
+    ]
+    requests = {
+        "terminal_spans": len(terminals),
+        "by_status": dict(sorted(by_status.items())),
+        "latency": _percentiles(latencies) if latencies else None,
+    }
+
+    worst = None
+    timed = [r for r in terminals if isinstance(r.get("duration_ms"), (int, float))]
+    if timed:
+        worst_row = max(timed, key=lambda r: r["duration_ms"])
+        trace_id = worst_row.get("trace_id")
+        trace_rows = [r for r in events if r.get("trace_id") == trace_id]
+        t0 = min(
+            (r["start_s"] for r in trace_rows if isinstance(r.get("start_s"), (int, float))),
+            default=0.0,
+        )
+        waterfall = []
+        for r in sorted(trace_rows, key=lambda r: (r.get("start_s") or 0.0)):
+            attrs = r.get("attrs") or {}
+            waterfall.append({
+                "span": r.get("span"),
+                "offset_ms": round(((r.get("start_s") or t0) - t0) * 1e3, 3),
+                "duration_ms": r.get("duration_ms"),
+                "status": r.get("status"),
+                # the scheduling attrs a human reads first; the rest stay
+                # in the events file
+                "attrs": {
+                    k: attrs[k] for k in
+                    ("slot", "bucket", "prefill_ms", "chunk", "decode_steps",
+                     "size", "execute_ms", "error")
+                    if k in attrs
+                },
+            })
+        worst = {
+            "trace_id": trace_id,
+            "status": worst_row.get("status"),
+            "duration_ms": worst_row.get("duration_ms"),
+            "spans": waterfall,
+        }
+
+    compiles = _compile_table(events, snapshot)
+    padding = _padding_waste(snapshot)
+    return {
+        "phases": phases,
+        "requests": requests,
+        "worst_request": worst,
+        "compiles": compiles,
+        "padding": padding,
+    }
+
+
+def _compile_table(events: List[dict], snapshot: dict) -> dict:
+    ledger = snapshot.get("compile_ledger") or {}
+    records = list(ledger.get("records") or [])
+    source = "snapshot" if records else None
+    if not records:
+        # fall back to the ledger.compile events the serve CLI forwards
+        for row in events:
+            if row.get("span") != "ledger.compile":
+                continue
+            attrs = row.get("attrs") or {}
+            records.append({
+                "site": attrs.get("site"),
+                # the one component the CLI forwards per event — keeps
+                # per-bucket rows distinguishable in the rendered table
+                "components": (
+                    {"bucket_shape": attrs["bucket_shape"]}
+                    if attrs.get("bucket_shape") else {}
+                ),
+                "compile_ms": attrs.get("compile_ms"),
+                "flops": attrs.get("flops"),
+                "bytes_accessed": attrs.get("bytes_accessed"),
+                "temp_bytes": attrs.get("temp_bytes"),
+                "output_bytes": attrs.get("output_bytes"),
+                "argument_bytes": attrs.get("argument_bytes"),
+                "retrace": attrs.get("retrace"),
+                "retrace_reasons": [
+                    r for r in (attrs.get("reasons") or "").split(",") if r
+                ],
+            })
+        source = "events" if records else None
+    reasons: Dict[str, int] = dict(ledger.get("retrace_reasons") or {})
+    if not reasons:
+        for rec in records:
+            for reason in rec.get("retrace_reasons") or []:
+                reasons[reason] = reasons.get(reason, 0) + 1
+    # prefer the snapshot's LIFETIME rollup fields: on long runs the record
+    # table is FIFO-bounded (keep=512) and summing it would under-report;
+    # events-only input recomputes from the rows it has
+    count = ledger.get("compiles")
+    retraces = ledger.get("retraces")
+    total_ms = ledger.get("compile_ms_total")
+    if count is None:
+        count = len(records)
+    if retraces is None:
+        retraces = sum(1 for r in records if r.get("retrace"))
+    if total_ms is None:
+        total_ms = round(
+            sum(float(r["compile_ms"]) for r in records
+                if isinstance(r.get("compile_ms"), (int, float))), 3,
+        )
+    return {
+        "source": source,
+        "count": int(count),
+        "retraces": int(retraces),
+        "retrace_reasons": dict(sorted(reasons.items())),
+        "compile_ms_total": float(total_ms),
+        "records": records,
+    }
+
+
+def _padding_waste(snapshot: dict) -> Optional[dict]:
+    counters = snapshot.get("counters") or {}
+    if not counters:
+        return None
+
+    def ratio(padded_key: str, total_key: str) -> Optional[float]:
+        total = counters.get(total_key)
+        if not total:
+            return None
+        return round(float(counters.get(padded_key, 0.0)) / float(total), 4)
+
+    real = counters.get("serving_prompt_tokens_real_total")
+    padded = counters.get("serving_prompt_tokens_padded_total")
+    return {
+        "prompt_padding_efficiency": (
+            None if not padded else round(float(real or 0.0) / float(padded), 4)
+        ),
+        "decode_rows_padding_waste": ratio(
+            "serving_decode_rows_padded_total", "serving_decode_rows_total"
+        ),
+    }
+
+
+def _fmt(value, width: int = 10) -> str:
+    if value is None:
+        return "-".rjust(width)
+    if isinstance(value, float):
+        return f"{value:,.2f}".rjust(width)
+    return f"{value:,}".rjust(width)
+
+
+def format_report(analysis: dict, *, top: int = 20) -> str:
+    """Human-readable rendering of :func:`analyze`'s output."""
+    out: List[str] = []
+
+    out.append("== per-phase latency breakdown ==")
+    phases = analysis["phases"]
+    if phases:
+        out.append(
+            f"{'span':<28}{'count':>8}{'total_ms':>12}{'p50_ms':>10}"
+            f"{'p95_ms':>10}{'max_ms':>10}"
+        )
+        for name, p in phases.items():
+            out.append(
+                f"{name:<28}{p['count']:>8}{_fmt(p['total_ms'], 12)}"
+                f"{_fmt(p['p50_ms'])}{_fmt(p['p95_ms'])}{_fmt(p['max_ms'])}"
+            )
+    else:
+        out.append("(no timed spans in events)")
+
+    req = analysis["requests"]
+    out.append("")
+    out.append("== requests ==")
+    out.append(
+        f"terminal spans: {req['terminal_spans']}  by status: "
+        + (", ".join(f"{k}={v}" for k, v in req["by_status"].items()) or "-")
+    )
+    if req["latency"]:
+        lat = req["latency"]
+        out.append(
+            f"request latency ms: p50={lat['p50_ms']} p95={lat['p95_ms']} "
+            f"max={lat['max_ms']} (n={lat['count']})"
+        )
+
+    worst = analysis["worst_request"]
+    out.append("")
+    out.append("== worst-request waterfall ==")
+    if worst:
+        out.append(
+            f"trace {worst['trace_id']}  status={worst['status']}  "
+            f"latency={worst['duration_ms']} ms"
+        )
+        for row in worst["spans"]:
+            attrs = "".join(
+                f" {k}={v}" for k, v in (row["attrs"] or {}).items()
+            )
+            out.append(
+                f"  +{row['offset_ms']:>10.3f} ms  {row['span']:<24}"
+                f" {row['duration_ms'] if row['duration_ms'] is not None else '-':>10}"
+                f" ms  [{row['status']}]{attrs}"
+            )
+    else:
+        out.append("(no timed terminal request spans)")
+
+    comp = analysis["compiles"]
+    out.append("")
+    out.append("== compile/memory ledger ==")
+    if comp["count"]:
+        out.append(
+            f"{comp['count']} compiles ({comp['retraces']} retraces) from "
+            f"{comp['source']}; compile_ms_total={comp['compile_ms_total']}"
+        )
+        if comp["retrace_reasons"]:
+            out.append(
+                "retrace reasons: "
+                + ", ".join(f"{k}={v}" for k, v in comp["retrace_reasons"].items())
+            )
+        out.append(
+            f"{'site':<20}{'compile_ms':>12}{'flops':>14}{'bytes_acc':>12}"
+            f"{'temp_B':>10}{'out_B':>10}  retrace"
+        )
+        ranked = sorted(
+            comp["records"],
+            key=lambda r: -(r.get("compile_ms") or 0.0),
+        )[:top]
+        for rec in ranked:
+            comps = rec.get("components") or {}
+            shape = comps.get("bucket_shape") or comps.get("chunk") or ""
+            site = f"{rec.get('site')}{f'[{shape}]' if shape else ''}"
+            reason = ",".join(rec.get("retrace_reasons") or []) or "-"
+            out.append(
+                f"{site:<20}{_fmt(rec.get('compile_ms'), 12)}"
+                f"{_fmt(rec.get('flops'), 14)}{_fmt(rec.get('bytes_accessed'), 12)}"
+                f"{_fmt(rec.get('temp_bytes'))}{_fmt(rec.get('output_bytes'))}"
+                f"  {reason}"
+            )
+        if len(comp["records"]) > top:
+            out.append(f"(+{len(comp['records']) - top} more; --top to widen)")
+    else:
+        out.append("(no ledger data: pass --snapshot or record ledger.compile events)")
+
+    pad = analysis["padding"]
+    out.append("")
+    out.append("== padding waste ==")
+    if pad:
+        out.append(
+            f"prompt_padding_efficiency={pad['prompt_padding_efficiency']}  "
+            f"decode_rows_padding_waste={pad['decode_rows_padding_waste']}"
+        )
+    else:
+        out.append("(no snapshot counters)")
+    return "\n".join(out)
+
+
+def run(events_path: str, snapshot_path: Optional[str] = None, *,
+        top: int = 20, as_json: bool = False) -> str:
+    """Load artifacts, analyze, and return the rendered report (the string
+    the CLI prints)."""
+    events = read_events_jsonl(events_path)
+    snapshot = None
+    if snapshot_path:
+        with open(snapshot_path) as fh:
+            snapshot = json.load(fh)
+    analysis = analyze(events, snapshot)
+    if as_json:
+        return json.dumps(analysis, indent=2, sort_keys=True)
+    return format_report(analysis, top=top)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="perceiver_io_tpu.observability.report",
+        description="Offline obs report over events.jsonl (+ snapshot).",
+    )
+    parser.add_argument("events", help="events.jsonl path (--obs.events_path)")
+    parser.add_argument("--snapshot", default=None,
+                        help="metrics snapshot JSON (--obs.snapshot_path)")
+    parser.add_argument("--top", type=int, default=20,
+                        help="rows shown in the compile table")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw analysis JSON instead of text")
+    args = parser.parse_args(argv)
+    try:
+        print(run(args.events, args.snapshot, top=args.top, as_json=args.json))
+    except OSError as e:
+        raise SystemExit(f"obs report: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(
+            f"obs report: --snapshot is not valid JSON "
+            f"({args.snapshot}: {e})"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

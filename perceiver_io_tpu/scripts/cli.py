@@ -267,10 +267,22 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
         )
     snapshot_writer = None
     if (obs.snapshot_every_s is not None or obs.snapshot_path is not None) and is_main:
+        from perceiver_io_tpu.observability import default_ledger, default_registry
+
         snapshot_writer = SnapshotWriter(
             registry,
             _resolve(obs.snapshot_path or "metrics_snapshot.json"),
             every_s=obs.snapshot_every_s,
+            # every written snapshot embeds the device-cost ledger table
+            # (per-executor compile/memory costs for an offline `obs
+            # report`) AND the process-wide registry, where the ledger's
+            # counter families, the executor-cache counters, and the
+            # hbm/resident gauges live — the run-scoped registry alone
+            # would silently drop them
+            extra=lambda: {
+                "compile_ledger": default_ledger().snapshot(),
+                "process_metrics": default_registry().snapshot(),
+            },
         )
     trigger = None
     if obs.profile_on_regress_factor is not None and is_main:
@@ -399,11 +411,45 @@ class CLI:
             self._print_help()
             return None
         subcommand = argv[0]
-        if subcommand not in ("fit", "validate", "test", "preproc", "serve"):
+        if subcommand not in ("fit", "validate", "test", "preproc", "serve", "obs"):
             raise SystemExit(
                 f"unknown subcommand {subcommand!r} "
-                "(fit|validate|test|preproc|serve)"
+                "(fit|validate|test|preproc|serve|obs)"
             )
+        if subcommand == "obs":
+            # offline analyzer — no checkpoint, no datamodule, no jax work:
+            # `obs report` reads the artifacts a run left behind
+            # (docs/observability.md)
+            if len(argv) < 2 or argv[1] != "report":
+                raise SystemExit(
+                    "usage: obs report --events <events.jsonl> "
+                    "[--snapshot <snapshot.json>] [--top N] [--json true]"
+                )
+            known = {"events": str, "snapshot": str, "top": int, "json": bool}
+            vals = _parse_dotted(argv[2:], known)
+            if "events" not in vals:
+                raise SystemExit("obs report requires --events <events.jsonl>")
+            import json as _json
+
+            from perceiver_io_tpu.observability import report as report_mod
+
+            try:
+                text = report_mod.run(
+                    vals["events"], vals.get("snapshot"),
+                    top=int(vals.get("top", 20)),
+                    as_json=bool(vals.get("json", False)),
+                )
+            except OSError as e:
+                # bad artifact paths get the same clean one-line errors as
+                # every other flag mistake, not a traceback
+                raise SystemExit(f"obs report: {e}")
+            except _json.JSONDecodeError as e:
+                raise SystemExit(
+                    f"obs report: --snapshot is not valid JSON "
+                    f"({vals.get('snapshot')}: {e})"
+                )
+            print(text)
+            return text
         if subcommand == "serve":
             # serve needs no datamodule: the checkpoint's embedded config
             # picks the model, and prompts come from a file or stdin.
@@ -599,101 +645,126 @@ class CLI:
             raise SystemExit("serve requires --ckpt <save_pretrained dir>")
         args = build_dataclass(ServeArgs, values, "serve")
         obs = build_dataclass(ObservabilityArgs, values, "obs")
-        if obs.profile_on_regress_factor is not None:
-            # only the trainer loop feeds a ProfilerTrigger; silently
-            # accepting the flag here would look configured while doing
-            # nothing
-            raise SystemExit(
-                "--obs.profile_on_regress_factor applies to fit, not serve"
-            )
         kit = _obs_kit(obs, os.getcwd())
         # serve lines always carry a trace_id (the events.jsonl join key),
         # so the engine always gets a tracer — sink-less when --obs.events_path
         # is unset (spans stay in the bounded in-memory buffer).
         tracer = kit["tracer"] or Tracer()
-        params, model_cfg = load_pretrained(ckpt)
-        if model_cfg is None:
-            raise SystemExit(f"{ckpt} has no embedded model config")
-        model = model_for_config(model_cfg)
-        from perceiver_io_tpu.models.text.clm import CausalLanguageModel
+        # the device-cost ledger's builds stream into events.jsonl as
+        # `ledger.compile` events, so an offline `obs report` over the
+        # events alone still carries the compile/memory table
+        from perceiver_io_tpu.observability import default_ledger
 
-        if not isinstance(model, CausalLanguageModel):
-            # The decode side is the byte tokenizer; a non-text AR family
-            # (e.g. symbolic audio) would sample ids the tokenizer cannot
-            # decode — fail fast instead of mid-stream.
-            raise SystemExit(
-                "serve currently supports text CLM checkpoints (byte "
-                f"tokenizer); got {type(model).__name__}"
+        ledger = default_ledger()
+        detach_ledger = ledger.attach(
+            lambda rec: tracer.event(
+                "ledger.compile",
+                site=rec["site"],
+                compile_ms=rec["compile_ms"],
+                flops=rec["flops"],
+                bytes_accessed=rec["bytes_accessed"],
+                argument_bytes=rec["argument_bytes"],
+                output_bytes=rec["output_bytes"],
+                temp_bytes=rec["temp_bytes"],
+                retrace=rec["retrace"],
+                reasons=",".join(rec["retrace_reasons"]),
+                bucket_shape=rec["components"].get("bucket_shape"),
             )
-
-        table = BucketTable.for_model(model)
-        if args.prompt_buckets or tuple(args.batch_buckets) != (1, 2, 4, 8):
-            table = BucketTable(
-                prompt_lens=tuple(args.prompt_buckets or table.prompt_lens),
-                batch_sizes=tuple(args.batch_buckets),
-            )
-        tok = ByteTokenizer(padding_side="left")
-        gen_cfg = GenerationConfig(
-            max_new_tokens=args.max_new_tokens,
-            num_latents=args.num_latents,
-            pad_token_id=tok.pad_token_id or 0,
-            eos_token_id=tok.eos_token_id,
-            sampling=SamplingConfig(temperature=args.temperature),
-        )
-        if args.engine not in ("bucket", "slots"):
-            raise SystemExit(
-                f"--serve.engine must be 'bucket' or 'slots', got {args.engine!r}"
-            )
-        from perceiver_io_tpu.inference import decode_strategy as strategy_mod
-
-        decode_mode = _serve_decode_mode(args.decode_strategy)
-        if args.decode_strategy_file:
-            # persisted verdicts short-circuit the warmup autotune; fresh
-            # verdicts measured this run are written back on warmup
-            strategy_mod.load_registry(args.decode_strategy_file)
-        engine_kwargs = dict(
-            rng=jax.random.PRNGKey(args.seed),
-            max_queue=args.max_queue,
-            default_deadline_s=args.deadline_s,
-            registry=kit["registry"],
-            tracer=tracer,
-            decode_strategy=decode_mode,
-        )
-        if args.engine == "slots":
-            engine = SlotServingEngine(
-                model, params, gen_cfg, table, slots=args.slots,
-                prefill_chunk=args.prefill_chunk, **engine_kwargs
-            )
-        else:
-            if args.prefill_chunk is not None:
-                raise SystemExit(
-                    "--serve.prefill_chunk applies to --serve.engine=slots "
-                    "(the bucket engine has no resident decode to interleave)"
-                )
-            engine = ServingEngine(model, params, gen_cfg, table, **engine_kwargs)
-        if args.warmup:
-            t0 = time.monotonic()
-            compiles = engine.warmup()
-            print(
-                f"[serve] warmup compiled {compiles} executors in "
-                f"{time.monotonic() - t0:.1f}s", file=sys.stderr, flush=True,
-            )
-            if args.decode_strategy_file and decode_mode == "auto":
-                strategy_mod.save_registry(args.decode_strategy_file)
-
-        if args.prompts:
-            with open(args.prompts) as fh:
-                prompts = [line.rstrip("\n") for line in fh if line.strip()]
-        else:
-            prompts = [line.rstrip("\n") for line in sys.stdin if line.strip()]
-        if not prompts:
-            raise SystemExit("serve: no prompts (empty file/stdin)")
-
+        ) if kit["sink"] is not None else (lambda: None)
+        # everything from here on runs under the teardown finally:
+        # an error in checkpoint load / engine build / warmup must
+        # still detach the ledger callback (it closes over THIS
+        # run's tracer+sink — leaking it would stream later runs'
+        # compiles into a dead events file) and close the artifacts
         try:
+            params, model_cfg = load_pretrained(ckpt)
+            if model_cfg is None:
+                raise SystemExit(f"{ckpt} has no embedded model config")
+            model = model_for_config(model_cfg)
+            from perceiver_io_tpu.models.text.clm import CausalLanguageModel
+
+            if not isinstance(model, CausalLanguageModel):
+                # The decode side is the byte tokenizer; a non-text AR family
+                # (e.g. symbolic audio) would sample ids the tokenizer cannot
+                # decode — fail fast instead of mid-stream.
+                raise SystemExit(
+                    "serve currently supports text CLM checkpoints (byte "
+                    f"tokenizer); got {type(model).__name__}"
+                )
+
+            table = BucketTable.for_model(model)
+            if args.prompt_buckets or tuple(args.batch_buckets) != (1, 2, 4, 8):
+                table = BucketTable(
+                    prompt_lens=tuple(args.prompt_buckets or table.prompt_lens),
+                    batch_sizes=tuple(args.batch_buckets),
+                )
+            tok = ByteTokenizer(padding_side="left")
+            gen_cfg = GenerationConfig(
+                max_new_tokens=args.max_new_tokens,
+                num_latents=args.num_latents,
+                pad_token_id=tok.pad_token_id or 0,
+                eos_token_id=tok.eos_token_id,
+                sampling=SamplingConfig(temperature=args.temperature),
+            )
+            if args.engine not in ("bucket", "slots"):
+                raise SystemExit(
+                    f"--serve.engine must be 'bucket' or 'slots', got {args.engine!r}"
+                )
+            from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+
+            decode_mode = _serve_decode_mode(args.decode_strategy)
+            if args.decode_strategy_file:
+                # persisted verdicts short-circuit the warmup autotune; fresh
+                # verdicts measured this run are written back on warmup
+                strategy_mod.load_registry(args.decode_strategy_file)
+            engine_kwargs = dict(
+                rng=jax.random.PRNGKey(args.seed),
+                max_queue=args.max_queue,
+                default_deadline_s=args.deadline_s,
+                registry=kit["registry"],
+                tracer=tracer,
+                # serve-side p95 regression trigger: the slot engine feeds
+                # per-token decode-step times, the bucket engine per-batch
+                # execute times; an armed trigger captures the next dispatch
+                profiler_trigger=kit["trigger"],
+                decode_strategy=decode_mode,
+            )
+            if args.engine == "slots":
+                engine = SlotServingEngine(
+                    model, params, gen_cfg, table, slots=args.slots,
+                    prefill_chunk=args.prefill_chunk, **engine_kwargs
+                )
+            else:
+                if args.prefill_chunk is not None:
+                    raise SystemExit(
+                        "--serve.prefill_chunk applies to --serve.engine=slots "
+                        "(the bucket engine has no resident decode to interleave)"
+                    )
+                engine = ServingEngine(model, params, gen_cfg, table, **engine_kwargs)
+            if args.warmup:
+                t0 = time.monotonic()
+                compiles = engine.warmup()
+                print(
+                    f"[serve] warmup compiled {compiles} executors in "
+                    f"{time.monotonic() - t0:.1f}s", file=sys.stderr, flush=True,
+                )
+                if args.decode_strategy_file and decode_mode == "auto":
+                    strategy_mod.save_registry(args.decode_strategy_file)
+
+            if args.prompts:
+                with open(args.prompts) as fh:
+                    prompts = [line.rstrip("\n") for line in fh if line.strip()]
+            else:
+                prompts = [line.rstrip("\n") for line in sys.stdin if line.strip()]
+            if not prompts:
+                raise SystemExit("serve: no prompts (empty file/stdin)")
+
             return self._serve_prompts(engine, tok, prompts, args, kit)
         finally:
             # fit's teardown parity: even an exception mid-drain leaves a
             # final snapshot and a closed events file
+            detach_ledger()
+            ledger.update_device_gauges()
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write(force=True)
             if kit["sink"] is not None:
@@ -761,15 +832,24 @@ class CLI:
         for row in results:
             print(json.dumps(row), flush=True)
         if args.stats:
+            from perceiver_io_tpu.observability import default_ledger, default_registry
+
             stats = engine.stats()
             stats["health"] = engine.health()
             stats["wall_s"] = round(wall_s, 3)
             stats["metrics"] = engine.registry.snapshot()
+            # the engine's stats() carries the ledger rollup; serve_stats is
+            # the run's one durable record, so it ships the full per-executor
+            # compile/memory table AND the process-wide registry (compile_*/
+            # retrace_*/executor_cache_* counters, hbm/resident gauges —
+            # families that live beside, not on, the engine's registry)
+            stats["compile_ledger"] = default_ledger().snapshot()
+            stats["process_metrics"] = default_registry().snapshot()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return results
 
     def _print_help(self) -> None:
-        print(f"usage: {self.family.name} {{fit|validate|test|preproc|serve}} [--flag=value ...]")
+        print(f"usage: {self.family.name} {{fit|validate|test|preproc|serve|obs}} [--flag=value ...]")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --obs.* --config=<yaml> --data=<name> --ckpt=<dir>")
         print("serve: --ckpt=<dir> --serve.prompts=<file|stdin> --serve.max_new_tokens "
@@ -780,7 +860,9 @@ class CLI:
               "--serve.max_queue --serve.deadline_s")
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "
               "--obs.snapshot_path --obs.profile_on_regress_factor "
-              "(docs/observability.md)")
+              "(fit and serve; docs/observability.md)")
+        print("obs report: --events=<events.jsonl> [--snapshot=<snapshot.json>] "
+              "[--top N] [--json true] — offline latency/compile/padding report")
         print(f"data modules: {sorted(self.family.data_registry)}")
 
 

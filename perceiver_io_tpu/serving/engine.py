@@ -56,6 +56,7 @@ deterministic chaos harness (``reliability.chaos``) via the optional
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
@@ -72,6 +73,38 @@ from perceiver_io_tpu.inference.generate import (
 from perceiver_io_tpu.observability import MetricsRegistry, Tracer
 from perceiver_io_tpu.reliability import QueueFull
 from perceiver_io_tpu.serving.buckets import BucketTable
+
+#: shared no-op capture context for unarmed dispatches (nullcontext is
+#: stateless and re-enterable, so one instance serves every step)
+_NULL_CAPTURE = contextlib.nullcontext()
+
+
+class _SafeCapture:
+    """A profiler capture that cannot fail the dispatch it observes: enter
+    and exit errors (an already-active profiler session, an unwritable
+    capture dir) degrade to no capture instead of surfacing inside the
+    engine's executor-failure handler — which would terminally fail every
+    resident request over telemetry."""
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        try:
+            return self._ctx.__enter__()
+        except Exception:
+            self._ctx = None
+            return None
+
+    def __exit__(self, *exc):
+        if self._ctx is None:
+            return False
+        try:
+            return self._ctx.__exit__(*exc)
+        except Exception:
+            return False  # never replace the dispatch's own exception
 
 #: canonical registry counter names -> the legacy ``stats()`` keys they
 #: replace (kept as deprecation aliases; docs/observability.md)
@@ -146,6 +179,13 @@ class ServingEngine:
     :param tracer: optional span tracer — one trace per request, one
         terminal ``serving.request`` span per submission, one
         ``serving.batch`` span per micro-batch. None skips every span site.
+    :param profiler_trigger: optional
+        :class:`~perceiver_io_tpu.observability.ProfilerTrigger` watching
+        the serving device path (this engine feeds it per-batch
+        ``serving_device_execute_ms``; the slot engine feeds per-token
+        ``serving_decode_step_ms``). When a p95 regression arms it, the
+        NEXT device dispatch runs under a ``jax.profiler`` capture —
+        the serve-side twin of the trainer wiring (docs/observability.md).
     :param decode_strategy: per-phase decode strategy forwarded to every
         ``generate()`` dispatch — ``"auto" | "cached" | "recompute"``
         (``inference/decode_strategy.py``). ``None`` defers to
@@ -163,6 +203,7 @@ class ServingEngine:
                  chaos=None,
                  registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[Tracer] = None,
+                 profiler_trigger=None,
                  decode_strategy: Optional[str] = None):
         from perceiver_io_tpu.inference import decode_strategy as _strategy
 
@@ -205,6 +246,24 @@ class ServingEngine:
             "serving_decode_rows_padded_total",
         )
         self.tracer = tracer
+        self.profiler_trigger = profiler_trigger
+
+    def _device_capture(self, *, step=None):
+        """Context for one device dispatch: a profiler capture when the
+        trigger armed on the previous observation, else a shared no-op — so
+        the capture shows a representative regressed dispatch, not the blip
+        that armed it (the trainer-loop convention). ``step`` may be a
+        zero-arg callable, evaluated only when a capture actually runs —
+        keeps step-number bookkeeping off the unarmed per-token path."""
+        trigger = self.profiler_trigger
+        if trigger is None or not trigger.armed:
+            return _NULL_CAPTURE
+        try:
+            return _SafeCapture(
+                trigger.capture(step=step() if callable(step) else step)
+            )
+        except Exception:
+            return _NULL_CAPTURE
 
     # -- queue front --------------------------------------------------------
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
@@ -481,13 +540,14 @@ class ServingEngine:
             batch_fault = self._chaos.hit("serving.batch") if self._chaos else None
             if batch_fault is not None and batch_fault.kind == "error":
                 raise batch_fault.make_error()
-            out = np.asarray(
-                generate(
-                    self.model, self.params, jnp.asarray(ids), cfg,
-                    rng=key, prompt_pad_count=jnp.asarray(pad_count),
-                    decode_strategy=self.decode_strategy,
+            with self._device_capture(step=batch_index):
+                out = np.asarray(
+                    generate(
+                        self.model, self.params, jnp.asarray(ids), cfg,
+                        rng=key, prompt_pad_count=jnp.asarray(pad_count),
+                        decode_strategy=self.decode_strategy,
+                    )
                 )
-            )
         except Exception as e:
             # Executor failure: this micro-batch fails, the queue survives.
             self.registry.observe(
@@ -504,6 +564,8 @@ class ServingEngine:
         # plus dispatch — the per-batch execute phase of the trace.
         execute_ms = (self._clock() - execute_t0) * 1e3
         self.registry.observe("serving_device_execute_ms", execute_ms)
+        if self.profiler_trigger is not None:
+            self.profiler_trigger.observe(execute_ms)
         if batch_span is not None:
             self.tracer.end_span(batch_span, execute_ms=round(execute_ms, 3))
         for i, req in enumerate(picked):
@@ -591,11 +653,20 @@ class ServingEngine:
         )
         real = counts.get("serving_prompt_tokens_real_total", 0)
         padded = counts.get("serving_prompt_tokens_padded_total", 0)
+        # compile-ledger rollup (docs/observability.md): the full per-key
+        # compile/memory table stays on default_ledger().snapshot() — the
+        # serve CLI embeds it in serve_stats; stats() carries the summary
+        # so a poller sees compile cost and retrace reasons without the
+        # per-record bulk
+        from perceiver_io_tpu.observability import default_ledger
+
+        ledger = default_ledger().rollup()
         return {
             **counters,
             "queued": len(self._queue),
             "compiles": cache["misses"],
             "executor_cache": cache,
+            "compile_ledger": ledger,
             # registry.percentile is the LOCKED accessor — stats() may be
             # polled from a scrape thread while the owner thread observes
             "queue_wait_ms": {

@@ -104,6 +104,7 @@ from perceiver_io_tpu.inference.generate import (
     _slot_decode_step,
     cached_executor,
     executor_cache_stats,
+    ledger_model_id,
     model_fingerprint,
     register_executor_cache,
 )
@@ -456,6 +457,20 @@ class SlotServingEngine(ServingEngine):
         self._pinned_boundary_mode: Optional[str] = None
         self._state = _blank_state(model, params, self.slots, self.config.pad_token_id)
         self._update_slot_gauges()
+        # analytic slot-KV footprint: the persistent cross/stack caches'
+        # byte size — exact on every backend, device memory_stats() or not
+        # (docs/observability.md, kv_cache_resident_bytes)
+        from perceiver_io_tpu.observability import default_ledger
+
+        kv_bytes = sum(
+            int(self._state[name].nbytes) for name in ("cross_k", "cross_v")
+        ) + sum(
+            int(leaf.nbytes)
+            for name in ("stack_k", "stack_v")
+            for leaf in self._state[name]
+        )
+        self.registry.set_gauge("kv_cache_resident_bytes", kv_bytes)
+        default_ledger().set_kv_cache_bytes(kv_bytes)
 
     # -- executors -----------------------------------------------------------
     def _cache_key(self, kind: str, *extra):
@@ -470,10 +485,32 @@ class SlotServingEngine(ServingEngine):
             cfg, self.slots, trace_env_fingerprint(), *extra,
         )
 
+    def _ledger_components(self, **extra) -> dict:
+        """Named cache-key components for the compile ledger — the same
+        knobs :meth:`_cache_key` folds into the tuple key, under the names
+        retrace attribution diffs (docs/observability.md taxonomy). Only
+        called on a cache MISS (the executor getters pass it as a thunk):
+        the model-id hash and config normalization stay off the per-token
+        hit path."""
+        from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
+
+        cfg = dataclasses.replace(self.config, max_new_tokens=0)
+        return {
+            "model": ledger_model_id(self.model),
+            "config": cfg,
+            "slots": self.slots,
+            "trace_env": trace_env_fingerprint(),
+            **extra,
+        }
+
     def _prefill_executor(self, bucket_len: int):
         return cached_executor(
             _EXECUTOR_CACHE, self._cache_key("slot_prefill", bucket_len),
             lambda: _build_prefill_executor(self.model, self.config, bucket_len),
+            ledger_site="slot_prefill",
+            ledger_components=lambda: self._ledger_components(
+                bucket_shape=f"1x{bucket_len}"
+            ),
         )
 
     def _chunked_prefill_executor(self):
@@ -482,6 +519,10 @@ class SlotServingEngine(ServingEngine):
             self._cache_key("slot_prefill_chunk", self.prefill_chunk),
             lambda: _build_chunked_prefill_executor(
                 self.model, self.config, self.prefill_chunk
+            ),
+            ledger_site="slot_prefill_chunk",
+            ledger_components=lambda: self._ledger_components(
+                chunk=self.prefill_chunk
             ),
         )
 
@@ -507,6 +548,10 @@ class SlotServingEngine(ServingEngine):
             _EXECUTOR_CACHE, self._cache_key("slot_decode", boundary, mode),
             lambda: _build_decode_executor(
                 self.model, self.config, boundary, mode
+            ),
+            ledger_site="slot_decode",
+            ledger_components=lambda: self._ledger_components(
+                boundary=boundary, decode_strategy=mode
             ),
         )
 
@@ -857,14 +902,24 @@ class SlotServingEngine(ServingEngine):
             if fault is not None and fault.kind == "error":
                 raise fault.make_error()
             executor = self._decode_executor(boundary)
-            self._state, tokens = executor(self.params, self._state, key)
-            tokens = np.asarray(tokens)  # host sync: the scheduling point
+            # armed by a serving_decode_step_ms p95 regression on a PRIOR
+            # step: this step (dispatch + host-sync fence) runs under the
+            # profiler capture; the step-number read (a registry lock) only
+            # happens when a capture actually fires
+            with self._device_capture(
+                step=lambda: int(self.registry.counter("serving_decode_steps_total"))
+            ):
+                self._state, tokens = executor(self.params, self._state, key)
+                tokens = np.asarray(tokens)  # host sync: the scheduling point
         except Exception as e:
             self.registry.observe(
                 "serving_decode_step_ms", (self._clock() - t0) * 1e3
             )
             return disposed + self._fail_resident(f"{type(e).__name__}: {e}")
-        self.registry.observe("serving_decode_step_ms", (self._clock() - t0) * 1e3)
+        decode_ms = (self._clock() - t0) * 1e3
+        self.registry.observe("serving_decode_step_ms", decode_ms)
+        if self.profiler_trigger is not None:
+            self.profiler_trigger.observe(decode_ms)
         self.registry.inc("serving_decode_steps_total")
         self.registry.inc("serving_decode_rows_total", self.slots)
         self.registry.inc("serving_decode_rows_padded_total", self.slots - len(active))

@@ -159,6 +159,29 @@ def test_prometheus_text_format():
     assert snap["histograms"]["wait_ms"]["count"] == 3
 
 
+def test_prometheus_help_lines_for_canonical_families():
+    """Every canonical family exports a # HELP line before its # TYPE;
+    ad-hoc names export # TYPE only (a scrape endpoint must be
+    self-describing — docs/observability.md)."""
+    reg = MetricsRegistry()
+    reg.inc("serving_requests_completed_total", 2)
+    reg.inc("compile_total")
+    reg.inc("retrace_reason_bucket_shape_total")  # prefix-matched family
+    reg.inc("adhoc_thing_total")
+    reg.set_gauge("kv_cache_resident_bytes", 1024)
+    reg.observe("compile_ms", 12.0)
+    text = to_prometheus_text(reg)
+    assert ("# HELP serving_requests_completed_total Requests that finished "
+            "with a generated result.\n# TYPE serving_requests_completed_total "
+            "counter") in text
+    assert "# HELP compile_total " in text
+    assert "# HELP compile_ms " in text and "# TYPE compile_ms summary" in text
+    assert "# HELP retrace_reason_bucket_shape_total Retraces attributed" in text
+    assert "# HELP kv_cache_resident_bytes " in text
+    assert "# HELP adhoc_thing_total" not in text
+    assert "# TYPE adhoc_thing_total counter" in text
+
+
 def test_snapshot_writer_cadence_and_force(tmp_path):
     clock = FakeClock()
     reg = MetricsRegistry()
@@ -222,14 +245,92 @@ def test_tracer_prefix_disambiguates_runs():
     assert a.start_span("x").span_id.startswith("a1.s")
 
 
-def test_serve_rejects_profiler_trigger_flag():
-    from perceiver_io_tpu.scripts.text import clm as clm_script
+def test_profiler_trigger_arms_on_serving_decode_regression(tiny_model):
+    """The serve-side trigger wiring (docs/observability.md): a slot engine
+    fed a decode-step p95 regression via FakeClock-controlled chaos-free
+    steps captures the NEXT decode dispatch. factor=0 arms on the first
+    post-baseline observation, so a short run suffices."""
+    captured = []
 
-    with pytest.raises(SystemExit, match="applies to fit"):
-        clm_script.main([
-            "serve", "--ckpt", "/nonexistent",
-            "--obs.profile_on_regress_factor=1.5",
-        ])
+    class _FakeCapture:
+        def __init__(self, d):
+            captured.append(d)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    from perceiver_io_tpu.serving import SlotServingEngine
+
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=4, num_latents=2, sampling=GREEDY)
+    trig = ProfilerTrigger(
+        "/tmp/unused-profile-dir", factor=0.0, min_samples=1, cooldown=100,
+        warmup=1, capture_fn=_FakeCapture,
+    )
+    engine = SlotServingEngine(
+        model, params, cfg, BucketTable(prompt_lens=(8,), batch_sizes=(1,)),
+        slots=1, profiler_trigger=trig,
+    )
+    engine.submit(_prompts(1)[0])
+    engine.run_until_idle()
+    # warmup(1) discards the first step, min_samples=1 freezes the baseline
+    # on the second, factor=0 arms on the third, the fourth is captured
+    assert trig.captures == 1 and len(captured) == 1
+
+
+def test_failing_capture_never_fails_requests(tiny_model):
+    """Observation must not change semantics: a profiler capture that
+    raises on construction or on enter (profiler already active, capture
+    dir unwritable) degrades to no capture — it must NOT land in the
+    decode path's executor-failure handler and fail resident requests."""
+    class _BoomOnEnter:
+        def __init__(self, d):
+            pass
+
+        def __enter__(self):
+            raise RuntimeError("profiler session already active")
+
+        def __exit__(self, *a):
+            return False
+
+    class _BoomOnInit:
+        def __init__(self, d):
+            raise OSError("capture dir unwritable")
+
+    from perceiver_io_tpu.serving import SlotServingEngine
+
+    model, params = tiny_model
+    for capture_fn in (_BoomOnEnter, _BoomOnInit):
+        cfg = GenerationConfig(max_new_tokens=4, num_latents=2, sampling=GREEDY)
+        trig = ProfilerTrigger(
+            "/tmp/unused-profile-dir", factor=0.0, min_samples=1,
+            cooldown=100, warmup=1, capture_fn=capture_fn,
+        )
+        engine = SlotServingEngine(
+            model, params, cfg, BucketTable(prompt_lens=(8,), batch_sizes=(1,)),
+            slots=1, profiler_trigger=trig,
+        )
+        engine.submit(_prompts(1)[0])
+        engine.run_until_idle()
+        assert engine.stats()["completed"] == 1
+        assert engine.stats()["failed"] == 0
+
+
+def test_serve_cli_accepts_profiler_trigger_flag(tmp_path):
+    """The serve-side hard error on --obs.profile_on_regress_factor is
+    gone: the flag reaches the engine as a ProfilerTrigger instead of
+    raising 'applies to fit, not serve'."""
+    from perceiver_io_tpu.observability import ObservabilityArgs
+    from perceiver_io_tpu.scripts.cli import _obs_kit
+
+    kit = _obs_kit(
+        ObservabilityArgs(profile_on_regress_factor=1.5), str(tmp_path)
+    )
+    assert isinstance(kit["trigger"], ProfilerTrigger)
+    assert kit["trigger"].factor == 1.5
 
 
 def test_tracer_event_and_backdated_start():
@@ -389,6 +490,53 @@ def test_compat_reader_normalizes_old_and_new_schema(tmp_path):
     assert rows[1]["metrics"] == {}
     assert rows[2]["text"] == {"samples/generated": "new-style"}
     assert len(rows) == 3  # torn line skipped
+
+
+def test_read_events_jsonl_edge_cases(tmp_path):
+    """Empty file, torn final line (SIGKILL mid-write), and blank lines all
+    yield clean rows — the analyzer must never die on a crashed run's
+    artifacts."""
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert read_events_jsonl(str(empty)) == []
+
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text(
+        json.dumps({"span": "a", "duration_ms": 1.0}) + "\n"
+        + "\n"
+        + json.dumps({"span": "b", "duration_ms": 2.0}) + "\n"
+        + '{"span": "c", "durat'  # truncated mid-write, no newline
+    )
+    rows = read_events_jsonl(str(torn))
+    assert [r["span"] for r in rows] == ["a", "b"]
+
+
+def test_read_metrics_jsonl_edge_cases(tmp_path):
+    """Empty file and a torn final line for the metrics compat reader, plus
+    INTERLEAVED old/new schema rows in one file (a run restarted across the
+    schema migration appends new-style rows after old-style ones)."""
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert read_metrics_jsonl(str(empty)) == []
+
+    path = tmp_path / "metrics.jsonl"
+    path.write_text(
+        json.dumps({"step": 1, "train/loss": 3.0}) + "\n"
+        + json.dumps({"step": 1, "samples/generated": "old text"}) + "\n"
+        + json.dumps({"step": 2, "text": {"samples/generated": "new text"}}) + "\n"
+        + json.dumps({"step": 2, "train/loss": 2.0, "train/lr": 0.1}) + "\n"
+        + json.dumps({"step": 3, "train/loss": 1.5}) + "\n"
+        + '{"step": 4, "train/l'  # torn final line
+    )
+    rows = read_metrics_jsonl(str(path))
+    assert len(rows) == 5  # torn line skipped, both schemas normalized
+    assert rows[0] == {"step": 1, "metrics": {"train/loss": 3.0}, "text": {}}
+    assert rows[1]["text"] == {"samples/generated": "old text"}
+    assert rows[2]["text"] == {"samples/generated": "new text"}
+    assert rows[3]["metrics"] == {"train/loss": 2.0, "train/lr": 0.1}
+    assert rows[4]["metrics"] == {"train/loss": 1.5}
+    # every normalized row exposes all three keys regardless of generation
+    assert all(set(r) == {"step", "metrics", "text"} for r in rows)
 
 
 # -- trainer integration ----------------------------------------------------
@@ -731,10 +879,13 @@ def test_bench_observability_probe_tiny(tiny_model):
 @pytest.mark.slow
 def test_instrumentation_overhead_under_2_percent():
     """StepTimer delta with full per-step instrumentation (registry counter +
-    two histogram observes + a traced span) vs bare, on a CPU bench-shaped
-    jitted step. The workload is sized so a step is ~10ms of real device
-    work; the instrumented path adds a handful of dict ops under one lock
-    and must stay within 2%."""
+    two histogram observes + a traced span + LEDGER-WRAPPED executor
+    dispatch) vs bare, on a CPU bench-shaped jitted step. The workload is
+    sized so a step is ~10ms of real device work; the instrumented path adds
+    a handful of dict ops under one lock plus the ledger wrapper's
+    compiled-dispatch indirection and must stay within 2%."""
+    from perceiver_io_tpu.observability import CompileLedger
+
     dim = 384
     w = jnp.eye(dim) * 1.001
 
@@ -755,10 +906,14 @@ def test_instrumentation_overhead_under_2_percent():
 
     registry = MetricsRegistry()
     tracer = Tracer()
+    # the ledger's steady-state hot-path cost: one wrapped-dispatch per step
+    # (AOT compile happens once, inside the warmup iterations)
+    ledger = CompileLedger(registry=registry)
+    wrapped_step = ledger.wrap(step, site="bench", components={"model": "t"})
 
     def instrumented():
         with tracer.span("trainer.step"):
-            out = step(x0)
+            out = wrapped_step(x0)
         registry.inc("trainer_steps_total")
         registry.observe("trainer_step_ms", 1.0)
         registry.observe("serving_queue_wait_ms", 1.0)
