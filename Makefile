@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos obs obs-report decode-strategy decode-tune cov bench serve-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos obs obs-report decode-strategy decode-tune cov bench serve-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -13,6 +13,11 @@ test-fast:
 # also included in the tier-1 "not slow" run
 chaos:
 	$(PY) -m pytest tests/ -q -m chaos --continue-on-collection-errors
+
+# supervised serving-fleet suite (docs/serving.md): replica failover,
+# circuit breakers, exactly-once recovery drills — CPU-fast, also tier-1
+fleet-chaos:
+	$(PY) -m pytest tests/ -q -m fleet --continue-on-collection-errors
 
 # unified telemetry layer suite (docs/observability.md) — CPU-fast,
 # also included in the tier-1 "not slow" run

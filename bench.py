@@ -554,6 +554,22 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "chaos": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: fleet chaos drill (mid-decode replica kill) ----
+        if left() > 90.0:
+            log("run: fleet-chaos probe (replica kill / failover / exactly-once)")
+            try:
+                flc = _bench_fleet_chaos(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "fleet_chaos": flc})
+                log(f"run: fleet-chaos completion_ratio={flc['completion_ratio']} "
+                    f"token_identical={flc['token_identical']} "
+                    f"(failovers {flc['failovers']}, redispatches "
+                    f"{flc['redispatches']}, goodput "
+                    f"{flc['goodput_tokens_per_sec']} tok/s)")
+            except Exception as e:
+                log(f"run: fleet-chaos probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "fleet_chaos": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: observability probe (telemetry layer end to end) ----
         if left() > 60.0:
             log("run: observability probe (histograms / goodput / MFU gauges)")
@@ -1189,6 +1205,90 @@ def _bench_chaos(model, params, cfg, *, n_requests: int = 8, new_tokens: int = 4
         "batches": s["batches"],
         "survived": accounted == n_requests and s["queued"] == 0,
         "ready_after_drain": engine.health()["ready"],
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def _bench_fleet_chaos(model, params, cfg, *, n_requests: int = 8,
+                       new_tokens: int = 6, replicas: int = 3):
+    """Supervised-fleet chaos drill (docs/serving.md): a FleetRouter over
+    ``replicas`` slot-engine replicas serves a mixed workload while a
+    scripted fault kills one replica MID-DECODE (``fleet.replica_step.<r>``
+    chaos site). The probe reports goodput and completion ratio under the
+    kill, and pins the recovery guarantees: every accepted request
+    completes exactly once and — greedy decode being deterministic — every
+    recovered output is token-identical to a no-fault reference run.
+    Scheduling runs on a FakeClock, so the fault script and outcome replay
+    bit-identically; only the goodput wall time is real."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.reliability.chaos import ChaosRegistry, FakeClock
+    from perceiver_io_tpu.serving import BucketTable, FleetRouter, SlotServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    num_latents = min(4, cfg.max_latents)
+    max_len = min(
+        16, cfg.max_seq_len - new_tokens,
+        cfg.max_seq_len - cfg.max_latents + num_latents,
+    )
+    table = BucketTable(prompt_lens=(max_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=max_len, dtype=np.int32)
+        for _ in range(n_requests)
+    ]
+
+    def run(chaos):
+        clock = FakeClock()
+
+        def factory():
+            return SlotServingEngine(
+                model, params, gcfg, table, slots=2, clock=clock,
+                rng=jax.random.PRNGKey(1),
+            )
+
+        fleet = FleetRouter(
+            [factory] * replicas, clock=clock, chaos=chaos,
+        )
+        reqs = [fleet.submit(p) for p in prompts]
+        fleet.run_until_idle()
+        return fleet, reqs
+
+    _, ref_reqs = run(None)  # no-fault reference (also warms the executors)
+    reference = [r.result for r in ref_reqs]
+
+    chaos = ChaosRegistry()
+    chaos.crash_replica(0, 3)  # replica 0's 3rd supervised step: mid-decode
+    t0 = time.perf_counter()
+    fleet, reqs = run(chaos)
+    wall_s = time.perf_counter() - t0
+    s = fleet.stats()
+    completed = sum(1 for r in reqs if r.status == "ok")
+    token_identical = all(
+        r.status == "ok" and np.array_equal(r.result, want)
+        for r, want in zip(reqs, reference)
+    )
+    return {
+        "replicas": replicas,
+        "submitted": n_requests,
+        "completed": completed,
+        "completion_ratio": round(completed / n_requests, 4),
+        "failovers": s["failovers"],
+        "redispatches": s["redispatches"],
+        "replica_restarts": s["replica_restarts"],
+        "duplicate_results_ignored": s["duplicate_results_ignored"],
+        "token_identical": token_identical,
+        # exactly-once accounting closes: every submission one disposition
+        "survived": (
+            s["completed"] + s["timed_out"] + s["failed"] == n_requests
+            and s["queued"] == 0 and s["dispatched"] == 0
+        ),
+        "goodput_tokens_per_sec": round(completed * new_tokens / wall_s, 2),
         "wall_s": round(wall_s, 3),
     }
 

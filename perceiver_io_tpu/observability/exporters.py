@@ -80,6 +80,22 @@ HELP_TEXT = {
     "trainer_step_ms": "Fenced true step time (profiler-trigger runs only).",
     "trainer_steps_per_sec": "Recent steady-state training step rate.",
     "trainer_loss": "Most recently logged training loss.",
+    "fleet_requests_submitted_total": "Requests accepted fleet-wide.",
+    "fleet_requests_completed_total": "Fleet requests completed exactly once.",
+    "fleet_requests_shed_total": "Submissions shed by fleet-level max_pending backpressure.",
+    "fleet_requests_timed_out_total": "Fleet requests whose deadline expired before completion.",
+    "fleet_requests_failed_total": "Fleet requests failed terminally (failover budget spent or failover off).",
+    "fleet_requests_rejected_total": "Submissions rejected as infeasible at the fleet front door.",
+    "fleet_dispatch_total": "Successful request placements onto a replica.",
+    "fleet_failover_total": "Replica-failure events that re-dispatched in-flight work.",
+    "fleet_redispatch_total": "Requests re-queued for replay on another replica.",
+    "fleet_breaker_open_total": "Circuit-breaker open transitions across replicas.",
+    "fleet_replica_failures_total": "Replica failures observed (crash, hang, dispatch fault).",
+    "fleet_replica_restarts_total": "Replica rebuilds (crash recovery or rolling restart).",
+    "fleet_duplicate_results_total": "Late duplicate completions absorbed by exactly-once dedupe.",
+    "fleet_replicas": "Replicas owned by the fleet router.",
+    "fleet_replicas_healthy": "Replicas with a closed circuit breaker right now.",
+    "fleet_request_latency_ms": "Fleet request latency: submit to terminal state (failovers included).",
 }
 
 #: prefix-matched fallbacks for generated families (per-reason counters,

@@ -19,6 +19,10 @@ no scrape endpoint, and no live process:
    forwards.
 4. **Padding waste** — prompt-token and decode-row real-vs-padded ratios
    from the snapshot counters.
+5. **Fleet supervision** (when the run had one, docs/serving.md) —
+   per-replica completion attribution from the terminal ``fleet.request``
+   spans, plus failover / redispatch / breaker-open / duplicate-dedupe
+   accounting from the ``fleet_*`` counters.
 
 Percentiles are computed through the SAME
 :class:`~perceiver_io_tpu.observability.Histogram` the live registry uses
@@ -132,6 +136,58 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
         "worst_request": worst,
         "compiles": compiles,
         "padding": padding,
+        "fleet": _fleet_section(events, snapshot),
+    }
+
+
+def _fleet_section(events: List[dict], snapshot: dict) -> Optional[dict]:
+    """Fleet supervision rollup (docs/serving.md): terminal ``fleet.request``
+    spans give per-replica completion attribution; the snapshot's ``fleet_*``
+    counters give failover / redispatch / breaker accounting. None when the
+    run had no fleet layer (single-engine artifacts stay unchanged)."""
+    terminals = [r for r in events if r.get("span") == "fleet.request"]
+    counters = snapshot.get("counters") or {}
+    gauges = snapshot.get("gauges") or {}
+    has_counters = any(k.startswith("fleet_") for k in counters)
+    if not terminals and not has_counters:
+        return None
+    by_status: Dict[str, int] = {}
+    by_replica: Dict[str, int] = {}
+    redispatched = 0
+    for r in terminals:
+        status = r.get("status", "?")
+        by_status[status] = by_status.get(status, 0) + 1
+        attrs = r.get("attrs") or {}
+        if status == "ok":
+            rid = attrs.get("replica")
+            if rid is not None:
+                by_replica[str(rid)] = by_replica.get(str(rid), 0) + 1
+        if (attrs.get("dispatches") or 0) > 1:
+            redispatched += 1
+
+    def c(name: str) -> Optional[int]:
+        v = counters.get(name)
+        return None if v is None else int(v)
+
+    return {
+        "terminal_spans": len(terminals),
+        "by_status": dict(sorted(by_status.items())),
+        "completed_by_replica": dict(sorted(by_replica.items())),
+        "requests_redispatched": redispatched,
+        "failovers": c("fleet_failover_total"),
+        "redispatches": c("fleet_redispatch_total"),
+        "breaker_opens": c("fleet_breaker_open_total"),
+        "replica_failures": c("fleet_replica_failures_total"),
+        "replica_restarts": c("fleet_replica_restarts_total"),
+        "duplicates_ignored": c("fleet_duplicate_results_total"),
+        "replicas": (
+            None if gauges.get("fleet_replicas") is None
+            else int(gauges["fleet_replicas"])
+        ),
+        "replicas_healthy": (
+            None if gauges.get("fleet_replicas_healthy") is None
+            else int(gauges["fleet_replicas_healthy"])
+        ),
     }
 
 
@@ -315,6 +371,42 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
             out.append(f"(+{len(comp['records']) - top} more; --top to widen)")
     else:
         out.append("(no ledger data: pass --snapshot or record ledger.compile events)")
+
+    fleet = analysis.get("fleet")
+    if fleet:
+        out.append("")
+        out.append("== fleet ==")
+        replicas = fleet.get("replicas")
+        healthy = fleet.get("replicas_healthy")
+        if replicas is not None:
+            out.append(f"replicas: {healthy}/{replicas} healthy")
+        out.append(
+            f"terminal spans: {fleet['terminal_spans']}  by status: "
+            + (", ".join(f"{k}={v}" for k, v in fleet["by_status"].items()) or "-")
+        )
+        if fleet["completed_by_replica"]:
+            out.append(
+                "completed by replica: "
+                + ", ".join(
+                    f"r{k}={v}" for k, v in fleet["completed_by_replica"].items()
+                )
+            )
+        if fleet["failovers"] is None:
+            # events-only input: the fleet.request spans exist but the
+            # fleet_* counters live in the snapshot (same fallback stance
+            # as the compile table's no-ledger message)
+            out.append(
+                "(no snapshot: failover/breaker counters unavailable — "
+                "pass --snapshot)"
+            )
+        else:
+            out.append(
+                f"failovers={fleet['failovers']}  "
+                f"redispatches={fleet['redispatches']}  "
+                f"breaker_opens={fleet['breaker_opens']}  "
+                f"replica_restarts={fleet['replica_restarts']}  "
+                f"duplicates_ignored={fleet['duplicates_ignored']}"
+            )
 
     pad = analysis["padding"]
     out.append("")

@@ -16,7 +16,8 @@ paths share:
 - :mod:`~perceiver_io_tpu.reliability.chaos` — a deterministic, seed-free
   fault-injection registry (``ChaosRegistry``) plus a controllable
   ``FakeClock``. Faults fire at explicit hook sites in the trainer, loader,
-  and serving engine — never via monkeypatched timing — so every chaos test
+  serving engines, and the fleet router (replica crash/hang + dispatch
+  faults) — never via monkeypatched timing — so every chaos test
   reproduces bit-identically on CPU.
 
 The trainer's divergence policies (``TrainerConfig.non_finite_policy`` =

@@ -5,19 +5,35 @@ fires when the site's hook is consulted with a matching key — there is no
 randomness, no wall clock, and no monkeypatching, so a chaos test replays
 bit-identically on CPU. The hook sites the codebase exposes:
 
-==================  =====================================================
-site                keying
-==================  =====================================================
-``trainer.step``    execution count (1-based): the Nth optimizer step this
-                    trainer ran — NOT the step index, so a fault does not
-                    re-fire when rollback replays the same step numbers
-``data.record``     execution count (1-based): the Nth record pulled from a
-                    chaos-wrapped source (:meth:`ChaosRegistry.wrap_source`)
-``serving.request`` explicit key: the ``request_id`` the engine assigned
-                    (0-based submission order)
-``serving.batch``   execution count (1-based): the Nth micro-batch the
-                    engine dispatched
-==================  =====================================================
+==========================  =============================================
+site                        keying
+==========================  =============================================
+``trainer.step``            execution count (1-based): the Nth optimizer
+                            step this trainer ran — NOT the step index, so
+                            a fault does not re-fire when rollback replays
+                            the same step numbers
+``data.record``             execution count (1-based): the Nth record
+                            pulled from a chaos-wrapped source
+                            (:meth:`ChaosRegistry.wrap_source`)
+``serving.request``         explicit key: the ``request_id`` the engine
+                            assigned (0-based submission order)
+``serving.batch``           execution count (1-based): the Nth micro-batch
+                            the engine dispatched
+``fleet.dispatch``          execution count (1-based): the Nth dispatch
+                            attempt the :class:`FleetRouter` performed,
+                            across all replicas — attempt-count keying is
+                            retry-safe (a re-dispatch of the same request
+                            is a NEW attempt, so an ``error`` fault fails
+                            one attempt, not the request forever)
+``fleet.replica_step.<r>``  per-replica execution count (1-based): the Nth
+                            supervised step of replica ``r``. ``error``
+                            models a scripted replica crash (the router
+                            restarts it and re-dispatches its in-flight
+                            work); ``hang`` advances the shared injectable
+                            clock by ``delay_s``, tripping the router's
+                            ``step_timeout_s`` wall-time deadline — the
+                            hung-replica drill
+==========================  =============================================
 
 Fault kinds: ``"error"`` (the site raises — or records — an exception),
 ``"nan"`` (the trainer replaces the step loss with NaN), ``"hang"`` (the
@@ -141,6 +157,31 @@ class ChaosRegistry:
         ``failed`` and the rest of the queue still drains."""
         return self.add("serving.batch", "error", batch_index,
                         exc_factory=exc_factory)
+
+    def crash_replica(self, replica_id: int, at_step: int, *, count: int = 1,
+                      exc_factory=None) -> Fault:
+        """Crash fleet replica ``replica_id`` on its ``at_step``-th supervised
+        step (1-based, and the ``count - 1`` following ones) — the scripted
+        mid-decode replica-kill drill (docs/serving.md): the router restarts
+        the replica and fails over its in-flight requests."""
+        return self.add(f"fleet.replica_step.{replica_id}", "error", at_step,
+                        count=count, exc_factory=exc_factory)
+
+    def hang_replica(self, replica_id: int, at_step: int, *,
+                     delay_s: float) -> Fault:
+        """Stall fleet replica ``replica_id``'s ``at_step``-th step for
+        ``delay_s`` clock seconds (needs the shared :class:`FakeClock`); a
+        stall past the router's ``step_timeout_s`` is detected as a hung
+        replica — its slow copy may still finish later, which is exactly the
+        duplicate-completion case the router's request-id dedupe absorbs."""
+        return self.add(f"fleet.replica_step.{replica_id}", "hang", at_step,
+                        delay_s=delay_s)
+
+    def fail_dispatch(self, attempt: int, *, count: int = 1) -> Fault:
+        """Fail the router's ``attempt``-th dispatch attempt (1-based,
+        fleet-wide) — the request is re-dispatched under the router's backoff
+        policy and the fault charges the chosen replica's circuit breaker."""
+        return self.add("fleet.dispatch", "error", attempt, count=count)
 
     # -- hook side ---------------------------------------------------------
     def hit(self, site: str, key: Optional[int] = None) -> Optional[Fault]:

@@ -192,11 +192,31 @@ class ServeArgs:
     #: append the engine stats JSON line to stdout after the results
     stats: bool = True
     #: bounded queue depth — submissions past it backpressure (the CLI then
-    #: drains a micro-batch and resubmits); None = unbounded
+    #: drains a micro-batch and resubmits); None = unbounded. With
+    #: ``replicas > 1`` this bounds the FLEET (queued + dispatched), not
+    #: each engine — admission is lifted to the router.
     max_queue: Optional[int] = None
     #: per-request deadline in seconds; requests that wait longer complete
     #: with a ``timed_out`` record instead of occupying a bucket slot
     deadline_s: Optional[float] = None
+    #: engine replicas behind a supervised FleetRouter (docs/serving.md):
+    #: load-aware dispatch, per-replica circuit breakers, failover with
+    #: exactly-once replay. 1 (default) drives the engine directly — no
+    #: fleet layer, no semantic drift.
+    replicas: int = 1
+    #: with ``replicas > 1``: re-dispatch a failed replica's in-flight
+    #: requests to survivors, replayed from their prompts (greedy outputs
+    #: stay token-identical). false = a replica failure fails its
+    #: in-flight requests terminally.
+    failover: bool = True
+    #: with ``replicas > 1``: wall-time deadline on one supervised replica
+    #: step — a slower (but returning) step marks the replica hung and
+    #: fails over its work. None (default) disables hang detection: set it
+    #: comfortably above your worst expected step (a cold compile inside
+    #: the first unwarmed step would otherwise trip it). A step that never
+    #: RETURNS is out of scope for the in-line supervisor — see
+    #: docs/serving.md.
+    step_timeout_s: Optional[float] = None
 
 
 def _serve_decode_mode(flag_value: str) -> str:
@@ -622,6 +642,13 @@ class CLI:
         run; a bounded queue (``--serve.max_queue``) backpressures by
         draining a micro-batch before resubmitting; timed-out or failed
         requests surface their status per line.
+
+        ``--serve.replicas=N`` (N > 1) serves through a supervised
+        :class:`~perceiver_io_tpu.serving.FleetRouter` — load-aware
+        dispatch over N engine replicas with circuit breakers and
+        (``--serve.failover``) exactly-once failover replay
+        (docs/serving.md); the router mirrors the engine surface, so the
+        prompt loop below is identical either way.
         """
         import json
         import os
@@ -717,11 +744,42 @@ class CLI:
                 # persisted verdicts short-circuit the warmup autotune; fresh
                 # verdicts measured this run are written back on warmup
                 strategy_mod.load_registry(args.decode_strategy_file)
+            if args.replicas < 1:
+                raise SystemExit(
+                    f"--serve.replicas must be >= 1, got {args.replicas}"
+                )
+            fleet_mode = args.replicas > 1
+            if not fleet_mode:
+                # inapplicable-flag convention (same as --serve.prefill_chunk
+                # with the bucket engine): asking for fleet supervision
+                # without a fleet must not silently do nothing
+                if args.step_timeout_s is not None:
+                    raise SystemExit(
+                        "--serve.step_timeout_s applies to --serve.replicas > 1 "
+                        "(hang detection is fleet supervision; a single engine "
+                        "is driven directly)"
+                    )
+                if not args.failover:
+                    print(
+                        "[serve] --serve.failover=false is a no-op with "
+                        "--serve.replicas=1 (no fleet layer, so there is no "
+                        "failover to disable)",
+                        file=sys.stderr, flush=True,
+                    )
             engine_kwargs = dict(
                 rng=jax.random.PRNGKey(args.seed),
-                max_queue=args.max_queue,
-                default_deadline_s=args.deadline_s,
-                registry=kit["registry"],
+                # with a fleet, admission (bounded queue + deadlines) is
+                # lifted to the router; the engines stay unbounded and
+                # enforce only the remaining deadline handed over per
+                # dispatch
+                max_queue=None if fleet_mode else args.max_queue,
+                default_deadline_s=None if fleet_mode else args.deadline_s,
+                # fleet replicas keep PRIVATE registries so serve_stats'
+                # per_replica engine stats attribute to one replica each
+                # (a shared registry would show fleet-wide aggregates on
+                # every row); the kit registry then carries the fleet_*
+                # supervision families
+                registry=None if fleet_mode else kit["registry"],
                 tracer=tracer,
                 # serve-side p95 regression trigger: the slot engine feeds
                 # per-token decode-step times, the bucket engine per-batch
@@ -730,17 +788,40 @@ class CLI:
                 decode_strategy=decode_mode,
             )
             if args.engine == "slots":
-                engine = SlotServingEngine(
-                    model, params, gen_cfg, table, slots=args.slots,
-                    prefill_chunk=args.prefill_chunk, **engine_kwargs
-                )
+                def make_engine():
+                    return SlotServingEngine(
+                        model, params, gen_cfg, table, slots=args.slots,
+                        prefill_chunk=args.prefill_chunk, **engine_kwargs
+                    )
             else:
                 if args.prefill_chunk is not None:
                     raise SystemExit(
                         "--serve.prefill_chunk applies to --serve.engine=slots "
                         "(the bucket engine has no resident decode to interleave)"
                     )
-                engine = ServingEngine(model, params, gen_cfg, table, **engine_kwargs)
+
+                def make_engine():
+                    return ServingEngine(
+                        model, params, gen_cfg, table, **engine_kwargs
+                    )
+            if fleet_mode:
+                from perceiver_io_tpu.serving import FleetRouter
+
+                # the fleet mirrors the engine request surface, so the
+                # whole prompt loop below drives it unchanged; the warm
+                # executor caches are process-global, so N replicas cost
+                # one compile pass
+                engine = FleetRouter(
+                    [make_engine] * args.replicas,
+                    max_pending=args.max_queue,
+                    default_deadline_s=args.deadline_s,
+                    failover=args.failover,
+                    step_timeout_s=args.step_timeout_s,
+                    registry=kit["registry"],
+                    tracer=tracer,
+                )
+            else:
+                engine = make_engine()
             if args.warmup:
                 t0 = time.monotonic()
                 compiles = engine.warmup()
@@ -785,9 +866,17 @@ class CLI:
             try:
                 # backpressure: make room BEFORE submitting so a full queue
                 # drains work instead of tripping the shed counter (shed
-                # should count true rejections, not this retry loop)
+                # should count true rejections, not this retry loop). A
+                # fleet with every breaker open makes no progress until a
+                # cooldown elapses — yield instead of hot-spinning (plain
+                # engines never report no-progress; their step always
+                # works when pending)
                 while not engine.health()["ready"] and engine.pending():
-                    engine.step()
+                    if (
+                        engine.step() == 0
+                        and not getattr(engine, "last_step_made_progress", True)
+                    ):
+                        time.sleep(0.005)
                 req = engine.submit(ids)
                 handles.append((p, req, None, req.trace_id, None))
             except (ValueError, QueueFull) as e:
@@ -808,7 +897,11 @@ class CLI:
         # pending(), not step()'s return value: a slot-engine step advances
         # one token and legitimately disposes of nothing mid-generation.
         while engine.pending():
-            engine.step()
+            if (
+                engine.step() == 0
+                and not getattr(engine, "last_step_made_progress", True)
+            ):
+                time.sleep(0.005)  # fleet waiting out a breaker cooldown
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write()
         engine.drain()  # queue already empty: just stop accepting
@@ -857,7 +950,9 @@ class CLI:
               "--serve.decode_strategy={auto|cached|recompute} "
               "--serve.decode_strategy_file "
               "--serve.prompt_buckets --serve.batch_buckets --serve.warmup "
-              "--serve.max_queue --serve.deadline_s")
+              "--serve.max_queue --serve.deadline_s "
+              "--serve.replicas=<n> --serve.failover={true|false} "
+              "--serve.step_timeout_s=<s>")
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "
               "--obs.snapshot_path --obs.profile_on_regress_factor "
               "(fit and serve; docs/observability.md)")

@@ -8,19 +8,36 @@ generation executors.
   persistent fixed-shape multi-slot decode state: per-token scheduling,
   immediate EOS/deadline retirement, mid-generation slot refill, one
   decode executor for all traffic.
+- :class:`FleetRouter` — N supervised engine replicas behind one
+  router: load-aware dispatch, per-replica health/circuit breakers,
+  crash/hang failure detection, and exactly-once failover that replays
+  in-flight requests from their prompts (token-identical under greedy
+  decoding).
 
-Both are hardened for load (docs/reliability.md): bounded queue with
+All are hardened for load (docs/reliability.md): bounded queue with
 :class:`QueueFull` backpressure, per-request deadlines, per-request error
-isolation, graceful ``drain()``, and a ``health()`` readiness snapshot.
+isolation, graceful ``drain()``, and a ``health()`` readiness snapshot
+sharing one schema (:data:`~perceiver_io_tpu.serving.engine.HEALTH_KEYS`).
 """
 from perceiver_io_tpu.reliability import QueueFull
 from perceiver_io_tpu.serving.buckets import BucketTable
-from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine
+from perceiver_io_tpu.serving.engine import HEALTH_KEYS, ServeRequest, ServingEngine
+from perceiver_io_tpu.serving.fleet import (
+    CircuitBreaker,
+    FleetRequest,
+    FleetRouter,
+    Replica,
+)
 from perceiver_io_tpu.serving.slots import SlotServingEngine
 
 __all__ = [
     "BucketTable",
+    "CircuitBreaker",
+    "FleetRequest",
+    "FleetRouter",
+    "HEALTH_KEYS",
     "QueueFull",
+    "Replica",
     "ServeRequest",
     "ServingEngine",
     "SlotServingEngine",

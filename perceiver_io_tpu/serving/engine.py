@@ -106,6 +106,18 @@ class _SafeCapture:
         except Exception:
             return False  # never replace the dispatch's own exception
 
+#: The shared health-snapshot schema contract (docs/serving.md): every
+#: ``health()`` in the serving layer — both engines, the fleet's per-replica
+#: snapshot, and the FleetRouter itself — exposes AT LEAST these keys, so a
+#: supervisor (the fleet router, a load balancer probe) reads any of them
+#: uniformly. Implementations may add keys (the slot engine adds ``slots``/
+#: ``slots_active``; a Replica adds breaker state) but never drop these.
+#: Pinned by the contract test in ``tests/test_fleet.py``.
+HEALTH_KEYS = frozenset({
+    "ready", "accepting", "queue_depth", "max_queue", "oldest_wait_ms",
+    "completed", "shed", "timed_out", "failed",
+})
+
 #: canonical registry counter names -> the legacy ``stats()`` keys they
 #: replace (kept as deprecation aliases; docs/observability.md)
 STAT_ALIASES = {
@@ -280,15 +292,7 @@ class ServingEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cfg = config or self.config
         try:
-            if prompt.size == 0:
-                raise ValueError("cannot serve an empty prompt")
-            if prompt.size > self.table.prompt_lens[-1]:
-                raise ValueError(
-                    f"prompt length {prompt.size} exceeds the largest bucket "
-                    f"{self.table.prompt_lens[-1]}; extend the bucket table or "
-                    "truncate the prompt"
-                )
-            self._pick_prompt_bucket(int(prompt.size), cfg)  # fail fast, not mid-batch
+            self.check_feasible(prompt, cfg)
         except ValueError as e:
             # infeasible submissions still get a terminal span + counter so
             # the CLI's per-line error records join against events.jsonl
@@ -318,6 +322,28 @@ class ServingEngine:
         self._queue.append(req)
         self.registry.inc("serving_requests_submitted_total")
         return req
+
+    def check_feasible(self, prompt, config: Optional[GenerationConfig] = None
+                       ) -> GenerationConfig:
+        """Raise the precise ``ValueError`` this engine's ``submit`` would
+        raise for an infeasible prompt (empty, longer than the largest
+        bucket, or — via the subclass's ``_pick_prompt_bucket`` — out of the
+        slot engine's scope), WITHOUT touching the queue or emitting spans;
+        returns the resolved config. The fleet router shares it for
+        fleet-level admission, so a request that no replica could ever serve
+        rejects at the front door instead of bouncing between replicas."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        cfg = config or self.config
+        if prompt.size == 0:
+            raise ValueError("cannot serve an empty prompt")
+        if prompt.size > self.table.prompt_lens[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest bucket "
+                f"{self.table.prompt_lens[-1]}; extend the bucket table or "
+                "truncate the prompt"
+            )
+        self._pick_prompt_bucket(int(prompt.size), cfg)  # fail fast, not mid-batch
+        return cfg
 
     def _terminal_event(self, status: str, **attrs) -> Optional[str]:
         """Emit a terminal ``serving.request`` span for a submission that

@@ -941,6 +941,20 @@ class SlotServingEngine(ServingEngine):
             served += self.step()
         return served
 
+    def drain(self) -> int:
+        """Graceful shutdown, token-granular (API parity with
+        :meth:`ServingEngine.drain` — the serve CLI and the fleet router's
+        rolling restart call one method on either engine instead of
+        hand-rolling ``while pending(): step()`` loops): stop accepting
+        submissions, then run every QUEUED request, the in-flight chunked
+        admission, and every RESIDENT slot to completion — a resident row
+        mid-generation finishes its remaining tokens rather than being
+        dropped. The base implementation already does the right thing
+        through the overridden :meth:`run_until_idle`; this override exists
+        to document (and pin, ``tests/test_fleet.py``) the token-granular
+        contract. Returns the number of requests disposed of; idempotent."""
+        return super().drain()
+
     # -- ahead-of-time warmup ------------------------------------------------
     def warmup(self, config: Optional[GenerationConfig] = None) -> int:
         """Compile every executor the engine can ever dispatch — one prefill

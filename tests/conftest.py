@@ -51,6 +51,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fleet: supervised serving-fleet test (replica health/failover/"
+        "exactly-once recovery; serving/fleet.py, docs/serving.md); "
+        "CPU-fast, runs in the tier-1 suite",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )
