@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos obs obs-report decode-strategy decode-tune cov bench serve-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos obs obs-report decode-strategy decode-tune cov bench serve-bench paged-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -64,6 +64,22 @@ serve-bench:
 	model = CausalLanguageModel(cfg); \
 	params = cast_float_params(model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params'], jnp.bfloat16); \
 	print(json.dumps({'serve_ab': bench._bench_serve_ab(model, params, cfg)}, indent=2))"
+
+# dense-vs-paged KV layout A/B at the CPU-fallback shape (docs/serving.md
+# "Block-paged KV"): a long-tail mixed-context workload through both slot
+# layouts at ONE simulated HBM budget, printing max concurrent residents,
+# the ratio, tokens/s, and the pool's page-utilization stats
+paged-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'paged_kv': bench._bench_paged_kv(model, params, cfg)}, indent=2))"
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

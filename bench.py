@@ -540,6 +540,22 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "prefill_chunk": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: paged-KV A/B (long-tail residents at a fixed HBM budget) ----
+        if left() > 150.0:
+            log("run: paged-KV A/B (dense vs block-paged residents at one budget)")
+            try:
+                pkv = _bench_paged_kv(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "paged_kv": pkv})
+                log(f"run: paged-KV residents {pkv['paged']['max_residents']} "
+                    f"vs dense {pkv['dense']['max_residents']} at the same "
+                    f"budget ({pkv['max_residents_ratio']}x, token_identical="
+                    f"{pkv['token_identical']}, paged "
+                    f"{pkv['paged']['tokens_per_sec']} tok/s)")
+            except Exception as e:
+                log(f"run: paged-KV A/B failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "paged_kv": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: chaos drill (fault-injected serving, deterministic) ----
         if left() > 60.0:
             log("run: chaos probe (backpressure / deadlines / fault isolation)")
@@ -971,6 +987,152 @@ def _bench_serve_ab(model, params, cfg, *, n_requests: int = 16, slots: int = 8)
         },
         "slots_vs_bucket_speedup": round(slot_tps / bucket_tps, 2),
         "slots_vs_bucket_exact_speedup": round(slot_tps / bucket_exact_tps, 2),
+    }
+
+
+def _bench_paged_kv(model, params, cfg, *, dense_slots: int = 4,
+                    paged_slots: int = 12, n_requests: int = 24,
+                    block_size: int = None):
+    """Dense-vs-paged KV layout A/B on a long-tail mixed-context workload
+    (ISSUE 9 acceptance; docs/serving.md "Block-paged KV"). The dense slot
+    engine sizes every resident's cross-KV cache at the FULL context, so a
+    simulated HBM budget of ``dense_slots`` context-lengths of KV caps it
+    at ``dense_slots`` residents no matter how short the requests are. The
+    paged engine gets the SAME budget as a block pool
+    (``kv_blocks = dense_slots * pages_per_slot``) behind more slots: each
+    resident consumes only its own ``ceil((prompt + max_new)/block)``
+    blocks, so the mostly-short long-tail traffic packs strictly more
+    concurrent residents into the same bytes — ``max_residents`` and the
+    ratio are the recorded acceptance numbers, alongside tokens/s, the
+    pool's page-utilization stats, and a token-identity check between the
+    two layouts' outputs (the exactness invariant, also pinned by
+    ``tests/test_paged_kv.py``).
+
+    Shapes derive from ``cfg``, so the probe runs at the reduced
+    CPU-fallback shape; prompt lengths are capped the way the other serve
+    probes cap them (the dense layout's per-resident cost is
+    context-sized regardless of prompt length, so the capacity comparison
+    is unaffected)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    n = cfg.max_seq_len
+    num_latents = min(4, cfg.max_latents)
+    if block_size is None:
+        block_size = max(4, n // 32)
+    pages_per_slot = -(-n // block_size)
+    short_new = max(2, min(8, cfg.max_latents - num_latents))
+    long_new = 2
+    short_len = max(num_latents, min(64, n // 8))
+    long_len = max(short_len, min(256, n // 2, model.max_prefix_len + num_latents,
+                                  n - long_new))
+    rng = np.random.default_rng(0)
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+
+    # greedy: the token-identity check must not depend on the two arms'
+    # PRNG streams lining up
+    base = GenerationConfig(
+        max_new_tokens=short_new, num_latents=num_latents,
+        sampling=SamplingConfig(temperature=0.0),
+    )
+    long_cfg = dataclasses.replace(base, max_new_tokens=long_new)
+    reqs = []
+    for i in range(n_requests):
+        if i % 6 == 1:  # the long tail: ~1 in 6 requests near the cap
+            reqs.append((
+                rng.integers(1, cfg.vocab_size, size=long_len, dtype=np.int32),
+                long_cfg,
+            ))
+        else:
+            reqs.append((
+                rng.integers(1, cfg.vocab_size, size=short_len, dtype=np.int32),
+                base,
+            ))
+    useful_tokens = sum(c.max_new_tokens for _, c in reqs)
+    table = BucketTable(
+        prompt_lens=tuple(sorted({short_len, long_len})), batch_sizes=(1,)
+    )
+    budget_blocks = dense_slots * pages_per_slot  # the simulated HBM budget
+
+    def run(make_engine):
+        compile_engine = make_engine()
+        for p, c in reqs:
+            compile_engine.submit(p, config=c)
+        compile_engine.run_until_idle()
+        engine = make_engine()
+        handles = []
+        for p, c in reqs:
+            handles.append(engine.submit(p, config=c))
+        max_residents = 0
+        t0 = time.perf_counter()
+        while engine.pending():
+            engine.step()
+            active = sum(1 for s in engine._slots if s is not None)
+            if engine._admitting is not None:
+                active += 1
+            max_residents = max(max_residents, active)
+        dt = time.perf_counter() - t0
+        return engine, dt, max_residents, [h.result for h in handles]
+
+    dense_engine, dense_dt, dense_res, dense_outs = run(
+        lambda: SlotServingEngine(
+            model, params, base, table, slots=dense_slots, kv_layout="dense"
+        )
+    )
+    paged_engine, paged_dt, paged_res, paged_outs = run(
+        lambda: SlotServingEngine(
+            model, params, base, table, slots=paged_slots, kv_layout="paged",
+            kv_block_size=block_size, kv_blocks=budget_blocks,
+        )
+    )
+    token_identical = all(
+        a is not None and b is not None and bool(np.array_equal(a, b))
+        for a, b in zip(dense_outs, paged_outs)
+    )
+    pool = paged_engine.stats()["kv_pool"]
+    token_bytes = paged_engine._kv_token_bytes
+    return {
+        "workload": {
+            "requests": n_requests,
+            "useful_tokens": useful_tokens,
+            "short_len": short_len,
+            "long_len": long_len,
+            "long_fraction": round(sum(1 for _, c in reqs if c is long_cfg)
+                                   / n_requests, 3),
+            "block_size": block_size,
+            "hbm_budget_blocks": budget_blocks,
+            "hbm_budget_bytes": budget_blocks * block_size * token_bytes,
+        },
+        "dense": {
+            "slots": dense_slots,
+            "max_residents": dense_res,
+            "tokens_per_sec": round(useful_tokens / dense_dt, 1),
+            "kv_resident_bytes": dense_slots * n * token_bytes,
+        },
+        "paged": {
+            "slots": paged_slots,
+            "max_residents": paged_res,
+            "tokens_per_sec": round(useful_tokens / paged_dt, 1),
+            "blocks_high_water": pool["high_water"],
+            "page_utilization_high_water": round(
+                pool["high_water"] / max(1, pool["blocks"]), 4
+            ),
+            "admit_waits": pool["admit_waits"],
+            "block_allocs": pool["allocs_total"],
+            "block_frees": pool["frees_total"],
+        },
+        "max_residents_ratio": round(paged_res / max(1, dense_res), 2),
+        "paged_vs_dense_tokens_ratio": round(
+            (useful_tokens / paged_dt) / (useful_tokens / dense_dt), 2
+        ),
+        "token_identical": token_identical,
     }
 
 

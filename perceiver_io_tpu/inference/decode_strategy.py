@@ -49,8 +49,18 @@ from typing import Callable, Optional, Union
 MODES = ("auto", "cached", "recompute")
 PHASE_CHOICES = ("cached", "recompute")
 
+#: slot-engine cross-KV layouts (docs/serving.md "Block-paged KV"): the
+#: dense-vs-paged choice is the SAME kind of measured platform/shape
+#: property as cached-vs-recompute — the paged gather's bookkeeping
+#: competes with the dense layout's footprint — so it lives in this
+#: module's registry, resolved and autotuned the same way.
+KV_LAYOUTS = ("auto", "dense", "paged")
+KV_LAYOUT_CHOICES = ("dense", "paged")
+
 #: env var overriding the boundary-phase strategy process-wide
 ENV_VAR = "PERCEIVER_DECODE_STRATEGY"
+#: env var overriding the slot engine's KV layout process-wide
+ENV_KV_LAYOUT = "PERCEIVER_KV_LAYOUT"
 #: env var pointing at a persisted strategy-registry JSON file
 ENV_FILE = "PERCEIVER_DECODE_STRATEGY_FILE"
 
@@ -89,7 +99,11 @@ class DecodeStrategy:
 
 #: (shape_key, platform, trace_env_fingerprint) -> measurement entry dict
 _REGISTRY: dict = {}
-_FILE_LOADED: set = set()  # paths already merged into _REGISTRY
+#: same key space -> {"kv_layout": "dense"|"paged", ...} measurement entry
+#: (separate dict so a boundary-only artifact and a kv-only artifact can
+#: merge without clobbering each other)
+_KV_REGISTRY: dict = {}
+_FILE_LOADED: set = set()  # paths already merged into the registries
 
 
 def shape_key(model) -> tuple:
@@ -142,9 +156,30 @@ def record(model, boundary: str, *, platform: Optional[str] = None,
     return entry
 
 
+def lookup_kv_layout(model, platform: Optional[str] = None) -> Optional[str]:
+    """Measured KV-layout winner for this shape/platform/env, or None."""
+    _maybe_load_env_file()
+    entry = _KV_REGISTRY.get(registry_key(model, platform))
+    return None if entry is None else entry["kv_layout"]
+
+
+def record_kv_layout(model, kv_layout: str, *, platform: Optional[str] = None,
+                     **extra) -> dict:
+    """Store a KV-layout verdict (plus measurement metadata) for this
+    shape/platform/env; returns the entry."""
+    if kv_layout not in KV_LAYOUT_CHOICES:
+        raise ValueError(
+            f"kv_layout must be one of {KV_LAYOUT_CHOICES}, got {kv_layout!r}"
+        )
+    entry = {"kv_layout": kv_layout, **extra}
+    _KV_REGISTRY[registry_key(model, platform)] = entry
+    return entry
+
+
 def reset_registry() -> None:
     """Test isolation: drop every memoized verdict and forget loaded files."""
     _REGISTRY.clear()
+    _KV_REGISTRY.clear()
     _FILE_LOADED.clear()
 
 
@@ -170,12 +205,22 @@ def save_registry(path: str) -> None:
             _REGISTRY.items(), key=lambda kv: repr(kv[0])
         )
     ]
+    kv_entries = [
+        {"key": _key_to_json(key), **entry} for key, entry in sorted(
+            _KV_REGISTRY.items(), key=lambda kv: repr(kv[0])
+        )
+    ]
     tmp = path + ".tmp"
     dirpath = os.path.dirname(path)
     if dirpath:
         os.makedirs(dirpath, exist_ok=True)
     with open(tmp, "w") as fh:
-        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+        # version stays 1: kv_entries is additive and readers written
+        # before it simply ignore the key
+        json.dump(
+            {"version": 1, "entries": entries, "kv_entries": kv_entries},
+            fh, indent=2,
+        )
     os.replace(tmp, path)
 
 
@@ -190,22 +235,28 @@ def load_registry(path: str) -> int:
             data = json.load(fh)
     except (OSError, ValueError):
         return 0
-    entries = data.get("entries") if isinstance(data, dict) else None
-    if not isinstance(entries, list):
+    if not isinstance(data, dict):
         return 0
     loaded = 0
-    for item in entries:
-        if not isinstance(item, dict):
+    for field, dest, value_key, choices in (
+        ("entries", _REGISTRY, "boundary", PHASE_CHOICES),
+        ("kv_entries", _KV_REGISTRY, "kv_layout", KV_LAYOUT_CHOICES),
+    ):
+        entries = data.get(field)
+        if not isinstance(entries, list):
             continue
-        try:
-            key = _key_from_json(item["key"])
-            entry = {k: v for k, v in item.items() if k != "key"}
-            if entry.get("boundary") not in PHASE_CHOICES:
+        for item in entries:
+            if not isinstance(item, dict):
                 continue
-        except (KeyError, ValueError, SyntaxError, TypeError):
-            continue
-        _REGISTRY[key] = entry
-        loaded += 1
+            try:
+                key = _key_from_json(item["key"])
+                entry = {k: v for k, v in item.items() if k != "key"}
+                if entry.get(value_key) not in choices:
+                    continue
+            except (KeyError, ValueError, SyntaxError, TypeError):
+                continue
+            dest[key] = entry
+            loaded += 1
     return loaded
 
 
@@ -314,6 +365,122 @@ def autotune_boundary(
         cached_ms_per_token=round(timings["cached"], 4),
         recompute_ms_per_token=round(timings["recompute"], 4),
         batch=batch, new_tokens=new_tokens,
+    )
+    if persist:
+        save_registry(persist)
+    return winner
+
+
+def resolve_kv_layout(
+    mode: Optional[str],
+    model=None,
+    *,
+    platform: Optional[str] = None,
+) -> str:
+    """Resolve a slot-engine KV-layout request into ``"dense"`` or
+    ``"paged"``.
+
+    Order mirrors :func:`resolve`: explicit mode > :data:`ENV_KV_LAYOUT` >
+    ``"auto"`` (registry lookup, falling back to ``dense`` — the
+    status-quo layout — when nothing has been measured).
+    """
+    if mode is None:
+        mode = os.environ.get(ENV_KV_LAYOUT) or "auto"
+    if mode not in KV_LAYOUTS:
+        raise ValueError(
+            f"kv layout must be one of {KV_LAYOUTS}, got {mode!r}"
+        )
+    if mode == "auto":
+        measured = lookup_kv_layout(model, platform) if model is not None else None
+        return measured or "dense"
+    return mode
+
+
+def autotune_kv_layout(
+    model,
+    params,
+    *,
+    slots: int = 2,
+    block_size: int = 16,
+    new_tokens: int = 8,
+    clock: Callable[[], float] = time.perf_counter,
+    persist: Optional[str] = None,
+    force: bool = False,
+) -> str:
+    """Measure dense vs block-paged slot decoding at the bound shape and
+    memoize the winner; returns ``"dense"`` or ``"paged"``.
+
+    The probe drives a tiny :class:`~perceiver_io_tpu.serving.slots.
+    SlotServingEngine` per layout (same prompts, same schedule, greedy):
+    one pass to compile, one timed pass, per-token ms on ``clock``. Ties —
+    including the all-zero durations an un-advanced FakeClock produces —
+    break toward ``dense`` (the status-quo layout), deterministically.
+    Note the tradeoff being measured is TIME at equal capacity; the paged
+    layout's admission win (more residents per HBM byte) is a capacity
+    property the ``extras.paged_kv`` bench measures separately — an
+    operator who sizes ``kv_blocks`` below dense capacity has already
+    chosen paged and should pass it explicitly.
+
+    :param persist: JSON path — merged before deciding (a persisted verdict
+        short-circuits the measurement unless ``force``) and rewritten
+        after, sharing the boundary registry's artifact file.
+    """
+    import jax
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.serving import BucketTable
+    from perceiver_io_tpu.serving.slots import SlotServingEngine
+
+    if persist:
+        load_registry(persist)
+    _maybe_load_env_file()
+    key = registry_key(model)
+    if not force and key in _KV_REGISTRY:
+        return _KV_REGISTRY[key]["kv_layout"]
+
+    n = model.max_seq_len
+    num_latents = min(2, model.max_latents)
+    # mid-context prompt: the paged gather's cost scales with the context,
+    # so probing at a trivial length would flatter the paged arm
+    prompt_len = max(num_latents, min(n // 2, model.max_prefix_len + num_latents))
+    new_tokens = max(1, min(new_tokens, n - prompt_len))
+    table = BucketTable(prompt_lens=(prompt_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, model.config.vocab_size, size=prompt_len, dtype=np.int32)
+        for _ in range(slots)
+    ]
+
+    timings = {}
+    for layout in KV_LAYOUT_CHOICES:
+        # explicit pool sizing implies the paged layout (the engine
+        # rejects sizing a dense pool), so only that arm gets block_size
+        kv_kwargs = (
+            {"kv_block_size": block_size} if layout == "paged" else {}
+        )
+
+        def make():
+            return SlotServingEngine(
+                model, params, gcfg, table, slots=slots, kv_layout=layout,
+                **kv_kwargs,
+            )
+
+        compile_engine = make()
+        compile_engine.serve(prompts)  # pays the per-layout executor builds
+        engine = make()
+        for p in prompts:
+            engine.submit(p)
+        t0 = clock()
+        engine.run_until_idle()
+        timings[layout] = (clock() - t0) / (slots * new_tokens) * 1e3
+    winner = "dense" if timings["dense"] <= timings["paged"] else "paged"
+    record_kv_layout(
+        model, winner,
+        dense_ms_per_token=round(timings["dense"], 4),
+        paged_ms_per_token=round(timings["paged"], 4),
+        slots=slots, block_size=block_size, new_tokens=new_tokens,
     )
     if persist:
         save_registry(persist)

@@ -460,6 +460,172 @@ def _slot_decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray,
     return logits, cache, length + 1, m + 1
 
 
+def _slot_decode_step_paged(
+    mdl, token: jnp.ndarray, pool_k, pool_v, block_table: jnp.ndarray,
+    stack_cache: dict, length: jnp.ndarray, m: jnp.ndarray,
+    block_size: int, write_ok: Optional[jnp.ndarray] = None,
+):
+    """:func:`_slot_decode_step` over the block-paged KV layout
+    (``serving/kv_pool.py``): the per-slot dense ``cross_k/cross_v`` rows
+    are replaced by ONE flat ``(pool_tokens, h, d)`` pool addressed through
+    ``block_table`` (``(b, pages)``; block 0 is the null/trash block). The
+    new token's k/v scatter lands at the table-translated append index, and
+    the attend runs through
+    :func:`~perceiver_io_tpu.ops.paged_attention.paged_decode_attention` —
+    a gather back to the dense view (bitwise-identical masked attend) or
+    the Pallas TPU kernel when enabled. The latent-stack cache stays dense:
+    it is bounded by ``max_latents`` (a model constant), not the context
+    length, so it is outside the ``slots × max_context`` scaling the pool
+    exists to break (docs/serving.md).
+
+    ``write_ok`` (per-row bool) redirects a row's append write to the null
+    block — the boundary-variant executor passes ``~is_boundary`` so the
+    per-row select between this step and the boundary step becomes *write
+    routing*: each live pool position is written by exactly the step the
+    dense layout's ``where`` select would have kept.
+
+    :return: (next-token logits, pool_k, pool_v, stack cache, length + 1,
+        m + 1).
+    """
+    from perceiver_io_tpu.ops import paged_attention as paged
+
+    ar = mdl.perceiver_ar
+    b = token.shape[0]
+    n = mdl.max_seq_len
+    num_latents = mdl.max_latents
+
+    wl = jnp.minimum(length, n - 1)  # write index; no-op clamp for active rows
+    p_new = wl[:, None]
+    emb, frq = ar.input_adapter(token[:, None], abs_pos=p_new)
+    rot = RotaryEmbedding(frq)
+
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    x_q = ca.q_norm(emb)  # the new token is a latent: q_norm on both sides
+    q = mha.project_q(x_q, rot)
+    k_new, v_new = mha.project_kv(x_q, rot)
+    flat_w = paged.flat_write_indices(block_table, wl, block_size)
+    if write_ok is not None:
+        # boundary rows' appends are owned by the boundary step; route this
+        # one to the null block (flat index < block_size is always trash)
+        flat_w = jnp.where(write_ok, flat_w, flat_w % block_size)
+    pool_k = pool_k.at[flat_w].set(k_new[:, :, 0].astype(pool_k.dtype))
+    pool_v = pool_v.at[flat_w].set(v_new[:, :, 0].astype(pool_v.dtype))
+    future = jnp.arange(n)[None, :] > length[:, None]  # True = not yet written
+    attn = paged.paged_decode_attention(
+        mha.attend, q, pool_k, pool_v, block_table,
+        block_size=block_size, n=n, pad_mask=future,
+        lengths=jnp.minimum(length + 1, n),
+    )
+    x = attn + emb
+    x = layer.mlp(x) + x
+
+    wm = jnp.minimum(m, num_latents - 1)
+    rows = jnp.arange(b)
+    stack_k, stack_v = [], []
+    stack_future = jnp.arange(num_latents)[None, :] > m[:, None]
+    for i, sa_layer in enumerate(ar.self_attention.layers):
+        sa = sa_layer.self_attn
+        r = rot if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        k_i = stack_cache["stack_k"][i].at[rows, :, wm].set(k_s[:, :, 0])
+        v_i = stack_cache["stack_v"][i].at[rows, :, wm].set(v_s[:, :, 0])
+        stack_k.append(k_i)
+        stack_v.append(v_i)
+        attn = sa.attention.attend(q_s, k_i, v_i, pad_mask=stack_future, deterministic=True)
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+
+    x_last = x[:, 0]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    stack = {"stack_k": stack_k, "stack_v": stack_v}
+    return logits, pool_k, pool_v, stack, length + 1, m + 1
+
+
+def _decode_step_boundary_paged(
+    mdl, window: jnp.ndarray, pad_count: jnp.ndarray, pool_k, pool_v,
+    block_table: jnp.ndarray, length: jnp.ndarray, block_size: int,
+    write_ok: Optional[jnp.ndarray] = None,
+):
+    """:func:`_decode_step_boundary` over the block-paged KV layout: the
+    migration + append writes become table-translated pool scatters and the
+    window-slot-aligned gather reads the pool instead of a dense per-row
+    cache. The computation between scatter and gather — latent embedding,
+    boundary-side re-normalization, attend, the full self-attention stack —
+    is the dense step's verbatim, so live rows' logits are bitwise
+    identical to the dense layout (the paged engine's parity claim).
+
+    ``write_ok`` routes NON-boundary rows' writes to the null block (the
+    inverse of :func:`_slot_decode_step_paged`'s routing — together they
+    reproduce the dense executor's per-row ``where`` select at every live
+    pool position).
+
+    :return: (next-token logits, pool_k, pool_v, length + 1).
+    """
+    from perceiver_io_tpu.ops import paged_attention as paged
+
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+
+    mig_abs = jnp.maximum((n - num_latents - 1) - pad_count[:, None], 0)
+    # append index clamped only for idle rows (saturated length); active
+    # boundary rows always satisfy length < n, matching the dense step
+    write_idx = jnp.concatenate(
+        [mig_abs, jnp.minimum(length, n - 1)[:, None]], axis=1
+    )
+
+    lat_abs = jnp.maximum(
+        jnp.arange(n - num_latents, n)[None, :] - pad_count[:, None], 0
+    )
+    emb_lat, frq_lat = ar.input_adapter(window[:, n - num_latents :], abs_pos=lat_abs)
+    x_q_lat = ca.q_norm(emb_lat)
+
+    emb_mig, frq_mig = ar.input_adapter(
+        window[:, n - num_latents - 1 : n - num_latents], abs_pos=mig_abs
+    )
+    k_mig, v_mig = mha.project_kv(ca.kv_norm(emb_mig), RotaryEmbedding(frq_mig))
+    k_new, v_new = mha.project_kv(
+        x_q_lat[:, -1:], RotaryEmbedding(frq_lat[:, -1:])
+    )
+    k_upd = jnp.concatenate([k_mig, k_new], axis=2).transpose(0, 2, 1, 3)
+    v_upd = jnp.concatenate([v_mig, v_new], axis=2).transpose(0, 2, 1, 3)
+    flat_wi = paged.flat_write_indices(block_table, write_idx, block_size)
+    if write_ok is not None:
+        flat_wi = jnp.where(write_ok[:, None], flat_wi, flat_wi % block_size)
+    pool_k = pool_k.at[flat_wi].set(k_upd.astype(pool_k.dtype))
+    pool_v = pool_v.at[flat_wi].set(v_upd.astype(pool_v.dtype))
+
+    slot_abs = jnp.maximum(jnp.arange(n)[None, :] - pad_count[:, None], 0)
+    flat_g = paged.flat_write_indices(block_table, slot_abs, block_size)
+    k_slots = paged.gather_kv(pool_k, flat_g)
+    v_slots = paged.gather_kv(pool_v, flat_g)
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    q = mha.project_q(x_q_lat, RotaryEmbedding(frq_lat, right_align=True))
+    attn = mha.attend(q, k_slots, v_slots, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb_lat
+    x = layer.mlp(x) + x
+
+    stack_pad = jnp.zeros((b, num_latents), bool)
+    x = ar.self_attention(
+        x, stack_pad, RotaryEmbedding(frq_lat, right_align=True), True
+    )
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    return logits, pool_k, pool_v, length + 1
+
+
 def _decode_step_boundary(
     mdl, window: jnp.ndarray, pad_count: jnp.ndarray, cross_k, cross_v, length,
     write_idx: Optional[jnp.ndarray] = None,

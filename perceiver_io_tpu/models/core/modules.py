@@ -97,7 +97,12 @@ def trace_env_fingerprint() -> tuple:
         # mirror flash_attention._candidates' validation (LANES == 128):
         # overrides it would ignore must fingerprint like the unset default
         blocks = ()
-    return (fused_qkv_enabled(), min_kv, blocks)
+    # PERCEIVER_PAGED_KERNEL switches the slot engine's paged decode
+    # attend between the gather reference and the Pallas TPU kernel at
+    # trace time (ops/paged_attention.py) — same mid-process-toggle
+    # contract as the flash knobs
+    paged_kernel = os.environ.get("PERCEIVER_PAGED_KERNEL", "0") == "1"
+    return (fused_qkv_enabled(), min_kv, blocks, paged_kernel)
 
 
 def _remat_policy(offload: bool):

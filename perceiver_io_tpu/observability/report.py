@@ -137,6 +137,49 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
         "compiles": compiles,
         "padding": padding,
         "fleet": _fleet_section(events, snapshot),
+        "kv_pool": _kv_pool_section(snapshot),
+    }
+
+
+def _kv_pool_section(snapshot: dict) -> Optional[dict]:
+    """Block-paged KV pool rollup (docs/serving.md "Block-paged KV"):
+    page utilization / high-water mark from the ``kv_pool_*`` gauges,
+    alloc/free churn from the counters, and the live-vs-worst-case byte
+    gauges (``kv_cache_resident_bytes`` vs ``kv_cache_capacity_bytes``).
+    None when the run had no paged slot engine — dense-run artifacts stay
+    unchanged."""
+    gauges = snapshot.get("gauges") or {}
+    counters = snapshot.get("counters") or {}
+    blocks = gauges.get("kv_pool_blocks")
+    if blocks is None:
+        return None
+
+    def g(name):
+        v = gauges.get(name)
+        return None if v is None else int(v)
+
+    def c(name):
+        v = counters.get(name)
+        return None if v is None else int(v)
+
+    in_use = g("kv_pool_blocks_in_use")
+    high = g("kv_pool_blocks_high_water")
+    return {
+        "blocks": int(blocks),
+        "blocks_in_use": in_use,
+        "blocks_reserved": g("kv_pool_blocks_reserved"),
+        "high_water": high,
+        "utilization": (
+            None if in_use is None else round(in_use / max(1, int(blocks)), 4)
+        ),
+        "high_water_utilization": (
+            None if high is None else round(high / max(1, int(blocks)), 4)
+        ),
+        "block_allocs": c("kv_pool_block_allocs_total"),
+        "block_frees": c("kv_pool_block_frees_total"),
+        "admit_waits": c("kv_pool_admit_waits_total"),
+        "resident_bytes": g("kv_cache_resident_bytes"),
+        "capacity_bytes": g("kv_cache_capacity_bytes"),
     }
 
 
@@ -406,6 +449,26 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"breaker_opens={fleet['breaker_opens']}  "
                 f"replica_restarts={fleet['replica_restarts']}  "
                 f"duplicates_ignored={fleet['duplicates_ignored']}"
+            )
+
+    kv = analysis.get("kv_pool")
+    if kv:
+        out.append("")
+        out.append("== kv pool ==")
+        out.append(
+            f"blocks: {kv['blocks_in_use']}/{kv['blocks']} in use "
+            f"(reserved {kv['blocks_reserved']}, high water {kv['high_water']}"
+            f" = {kv['high_water_utilization']})"
+        )
+        out.append(
+            f"churn: allocs={kv['block_allocs']} frees={kv['block_frees']} "
+            f"admit_waits={kv['admit_waits']}"
+        )
+        if kv["resident_bytes"] is not None and kv["capacity_bytes"]:
+            out.append(
+                f"resident {kv['resident_bytes']:,} B of worst-case "
+                f"{kv['capacity_bytes']:,} B "
+                f"({kv['resident_bytes'] / kv['capacity_bytes']:.1%})"
             )
 
     pad = analysis["padding"]

@@ -1,0 +1,158 @@
+"""Paged KV gather/scatter helpers + the ragged paged decode attention op.
+
+The slot engine's paged layout (``serving/kv_pool.py``, docs/serving.md)
+keeps every resident's cross-attention k/v in ONE flat device pool of
+shape ``(pool_tokens, heads, head_dim)``, addressed through per-slot
+block tables. This module is the device-side address arithmetic plus the
+decode-attention op over that layout, in two implementations:
+
+- **Gather reference (every backend).** Flatten the block table into
+  per-position pool indices, ``jnp.take`` the pages back into a dense
+  ``(b, h, n, d)`` view, and run the caller's attend. Because masking in
+  :func:`~perceiver_io_tpu.ops.attention.dot_product_attention` is a
+  ``where`` select on the fp32 logits, positions whose pages are
+  unmapped (they gather null-block trash) contribute exactly what the
+  dense layout's masked garbage contributes — nothing — so greedy output
+  is **bitwise identical** to the dense layout (pinned by
+  ``tests/test_paged_kv.py``). The gathered view is a transient XLA
+  temp, not resident HBM; the persistent footprint is the pool.
+- **Pallas TPU kernel (opt-in).** ``PERCEIVER_PAGED_KERNEL=1`` on a TPU
+  backend dispatches ``jax.experimental.pallas.ops.tpu.paged_attention``
+  (the SNIPPETS.md [1] usage), which reads only the live pages — the
+  "Ragged Paged Attention" kernel design. The kernel's blockwise softmax
+  is exact but not bit-identical to the XLA einsum, so it is opt-in and
+  the parity tests pin the gather path; the flag is folded into
+  ``modules.trace_env_fingerprint`` so a mid-process toggle rebuilds the
+  decode executors instead of silently reusing the other trace.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+#: trace-time env flag enabling the Pallas TPU kernel path (see module
+#: docstring; folded into ``modules.trace_env_fingerprint``)
+ENV_KERNEL = "PERCEIVER_PAGED_KERNEL"
+
+
+def kernel_requested() -> bool:
+    """Normalized read of :data:`ENV_KERNEL` (trace-time, like the flash
+    knobs — ``attention._flash_eligible`` discipline)."""
+    return os.environ.get(ENV_KERNEL, "0") == "1"
+
+
+def kernel_enabled() -> bool:
+    """True when the Pallas paged-attention kernel should be traced:
+    requested via env AND running on a TPU backend (the kernel is
+    Mosaic-only; every other backend uses the gather reference)."""
+    return kernel_requested() and jax.default_backend() == "tpu"
+
+
+def flat_position_indices(table: jnp.ndarray, block_size: int, n: int) -> jnp.ndarray:
+    """Pool indices for token positions ``0..n-1`` through a block table.
+
+    :param table: ``(..., pages)`` int32 block ids (0 = null block).
+    :param block_size: token positions per block.
+    :param n: positions to address (``<= pages * block_size``).
+    :return: ``(..., n)`` int32 indices into the flat token-major pool.
+    """
+    pos = jnp.arange(n, dtype=jnp.int32)
+    return table[..., pos // block_size] * block_size + pos % block_size
+
+
+def flat_write_indices(table: jnp.ndarray, positions: jnp.ndarray,
+                       block_size: int) -> jnp.ndarray:
+    """Pool indices for per-row write ``positions``.
+
+    :param table: ``(b, pages)`` int32 block table.
+    :param positions: ``(b, ...)`` int32 token positions (each row indexes
+        its own table row).
+    :return: same shape as ``positions``, indices into the flat pool.
+    """
+    b = table.shape[0]
+    rows = jnp.arange(b).reshape((b,) + (1,) * (positions.ndim - 1))
+    return table[rows, positions // block_size] * block_size + positions % block_size
+
+
+def gather_kv(pool: jnp.ndarray, flat_idx: jnp.ndarray) -> jnp.ndarray:
+    """Gather pool rows into a dense per-slot view.
+
+    :param pool: ``(pool_tokens, h, d)`` flat token-major pool.
+    :param flat_idx: ``(b, n)`` indices from :func:`flat_position_indices`.
+    :return: ``(b, h, n, d)`` dense view (transient).
+    """
+    return jnp.take(pool, flat_idx, axis=0).transpose(0, 2, 1, 3)
+
+
+def paged_decode_attention(
+    attend,
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    table: jnp.ndarray,
+    *,
+    block_size: int,
+    n: int,
+    pad_mask: jnp.ndarray,
+    lengths: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """One decode step's cross attention over the paged pool.
+
+    :param attend: the caller's attend (``mha.attend`` — the SAME callable
+        the dense layout runs, for bitwise parity on the gather path).
+    :param q: ``(b, h, 1, d)`` pre-scaled, pre-rotated query.
+    :param pool_k/pool_v: ``(pool_tokens, h, d)`` flat pools.
+    :param table: ``(b, pages)`` block table rows for these b slots.
+    :param block_size: pool block size in token positions.
+    :param n: dense context length being addressed.
+    :param pad_mask: ``(b, n)`` True = masked (the future/pad mask the
+        dense attend uses).
+    :param lengths: ``(b,)`` valid-token counts INCLUDING the position
+        written this step — only the kernel path consumes it (the gather
+        path's masking comes entirely from ``pad_mask``).
+    :return: ``(b, h, 1, d)`` attention output.
+    """
+    if kernel_enabled() and lengths is not None:
+        out = _pallas_paged_attention(
+            q, pool_k, pool_v, table, lengths, block_size=block_size
+        )
+        if out is not None:
+            return out
+    flat = flat_position_indices(table, block_size, n)
+    k = gather_kv(pool_k, flat)
+    v = gather_kv(pool_v, flat)
+    return attend(q, k, v, pad_mask=pad_mask, deterministic=True)
+
+
+def _pallas_paged_attention(q, pool_k, pool_v, table, lengths, *, block_size):
+    """Dispatch the Pallas TPU paged-attention kernel; None on any
+    unavailability (old jax, unsupported shape) so the caller degrades to
+    the gather reference instead of failing the decode step."""
+    try:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _kernel,
+        )
+    except Exception:
+        return None
+    try:
+        tokens, h, d = pool_k.shape
+        pages = tokens // block_size
+        # flat (tokens, h, d) -> kernel layout (kv_heads, pages, page, d)
+        k_pages = pool_k.reshape(pages, block_size, h, d).transpose(2, 0, 1, 3)
+        v_pages = pool_v.reshape(pages, block_size, h, d).transpose(2, 0, 1, 3)
+        # q arrives pre-scaled by ck**-0.5 (the projection applies it), and
+        # the kernel adds no scale of its own — consistent with the einsum
+        # path. One query token per sequence: (b, h, 1, d) -> (b, h, d).
+        out = _kernel(
+            q[:, :, 0, :],
+            k_pages,
+            v_pages,
+            lengths.astype(jnp.int32),
+            table.astype(jnp.int32),
+        )
+        return out[:, :, None, :].astype(q.dtype)
+    except Exception:
+        return None

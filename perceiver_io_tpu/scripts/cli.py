@@ -182,6 +182,23 @@ class ServeArgs:
     #: optional JSON path persisting the autotuner's verdicts, so one
     #: deployment measures once (also via PERCEIVER_DECODE_STRATEGY_FILE)
     decode_strategy_file: Optional[str] = None
+    #: slot-engine cross-KV layout (docs/serving.md "Block-paged KV"):
+    #: ``dense`` = per-slot worst-case caches; ``paged`` = shared block
+    #: pool + per-slot block tables (more residents per HBM byte under
+    #: long-tail traffic; greedy output identical); ``auto`` measures at
+    #: warmup and memoizes the winner (beaten by an explicit layout, defers
+    #: to PERCEIVER_KV_LAYOUT)
+    kv_layout: str = "auto"
+    #: token positions per KV pool block (paged layout; default
+    #: min(16, context))
+    kv_block_size: Optional[int] = None
+    #: usable KV pool capacity in blocks (paged layout). Default = dense
+    #: capacity (slots x pages-per-slot); set it LOWER to serve the same
+    #: slot count in less HBM — requests that can't currently fit wait at
+    #: the queue head, ones that never could reject at submit. Sizing the
+    #: pool requires --serve.kv_layout=paged (a dense resolution would
+    #: silently discard the budget, so the engine rejects the combination)
+    kv_blocks: Optional[int] = None
     #: prompt-length bucket grid; default = powers of two up to the context
     prompt_buckets: Optional[typing.Tuple[int, ...]] = None
     #: micro-batch size grid (``bucket`` engine; ignored by ``slots``)
@@ -244,6 +261,33 @@ def _serve_decode_mode(flag_value: str) -> str:
         raise SystemExit(
             f"{strategy_mod.ENV_VAR} must be one of "
             f"{'|'.join(strategy_mod.MODES)}, got {env_mode!r}"
+        )
+    return env_mode
+
+
+def _serve_kv_layout(flag_value: str) -> str:
+    """Resolve ``--serve.kv_layout`` against ``PERCEIVER_KV_LAYOUT`` — the
+    same deference rules as :func:`_serve_decode_mode`: an explicit
+    ``dense``/``paged`` flag beats the env var; the ``auto`` default
+    defers to it (then to the measured registry at engine construction)."""
+    import os
+
+    from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+
+    if flag_value not in strategy_mod.KV_LAYOUTS:
+        raise SystemExit(
+            "--serve.kv_layout must be one of "
+            f"{'|'.join(strategy_mod.KV_LAYOUTS)}, got {flag_value!r}"
+        )
+    if flag_value != "auto":
+        return flag_value
+    env_mode = os.environ.get(strategy_mod.ENV_KV_LAYOUT)
+    if not env_mode:
+        return flag_value
+    if env_mode not in strategy_mod.KV_LAYOUTS:
+        raise SystemExit(
+            f"{strategy_mod.ENV_KV_LAYOUT} must be one of "
+            f"{'|'.join(strategy_mod.KV_LAYOUTS)}, got {env_mode!r}"
         )
     return env_mode
 
@@ -787,17 +831,33 @@ class CLI:
                 profiler_trigger=kit["trigger"],
                 decode_strategy=decode_mode,
             )
+            kv_mode = _serve_kv_layout(args.kv_layout)
             if args.engine == "slots":
                 def make_engine():
                     return SlotServingEngine(
                         model, params, gen_cfg, table, slots=args.slots,
-                        prefill_chunk=args.prefill_chunk, **engine_kwargs
+                        prefill_chunk=args.prefill_chunk,
+                        kv_layout=kv_mode, kv_block_size=args.kv_block_size,
+                        kv_blocks=args.kv_blocks, **engine_kwargs
                     )
             else:
                 if args.prefill_chunk is not None:
                     raise SystemExit(
                         "--serve.prefill_chunk applies to --serve.engine=slots "
                         "(the bucket engine has no resident decode to interleave)"
+                    )
+                # inapplicable-flag convention: an explicitly paged (or
+                # sized) KV pool on the bucket engine must not silently do
+                # nothing. Checked on the RAW flags, not the env-resolved
+                # mode: a machine-wide PERCEIVER_KV_LAYOUT set for slot
+                # deployments must not break unrelated bucket-engine jobs
+                # on the same host.
+                if args.kv_layout != "auto" or args.kv_block_size is not None \
+                        or args.kv_blocks is not None:
+                    raise SystemExit(
+                        "--serve.kv_layout/--serve.kv_block_size/"
+                        "--serve.kv_blocks apply to --serve.engine=slots "
+                        "(the bucket engine has no persistent KV state to page)"
                     )
 
                 def make_engine():
@@ -829,7 +889,10 @@ class CLI:
                     f"[serve] warmup compiled {compiles} executors in "
                     f"{time.monotonic() - t0:.1f}s", file=sys.stderr, flush=True,
                 )
-                if args.decode_strategy_file and decode_mode == "auto":
+                if args.decode_strategy_file and (
+                    decode_mode == "auto"
+                    or (args.engine == "slots" and kv_mode == "auto")
+                ):
                     strategy_mod.save_registry(args.decode_strategy_file)
 
             if args.prompts:

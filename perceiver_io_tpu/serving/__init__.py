@@ -28,6 +28,7 @@ from perceiver_io_tpu.serving.fleet import (
     FleetRouter,
     Replica,
 )
+from perceiver_io_tpu.serving.kv_pool import KVPagePool, PoolExhausted
 from perceiver_io_tpu.serving.slots import SlotServingEngine
 
 __all__ = [
@@ -36,6 +37,8 @@ __all__ = [
     "FleetRequest",
     "FleetRouter",
     "HEALTH_KEYS",
+    "KVPagePool",
+    "PoolExhausted",
     "QueueFull",
     "Replica",
     "ServeRequest",

@@ -1,0 +1,220 @@
+"""Block-paged KV pool: the host-side allocator behind the slot engine's
+paged KV layout (``kv_layout="paged"``, docs/serving.md).
+
+The dense slot state sizes every resident's cross-KV cache at the full
+context length, so HBM cost is ``slots × max_context`` even when most
+residents are short — the direct ceiling on slot count under mixed-length
+traffic (ROADMAP open item 1; the "Ragged Paged Attention" TPU-serving
+design in PAPERS.md is the kernel-side half of the fix). This module is
+the pool-side half: ONE fixed device pool of KV blocks (``block_size``
+token positions each) shared by every slot, with a per-slot **block
+table** mapping token-index pages to pool blocks. A request only ever
+consumes ``ceil((prompt + max_new) / block_size)`` blocks — its own
+worst case, not the context's — so a pool sized for ``B`` dense residents
+admits strictly more mixed-length ones.
+
+Design rules (all pinned by ``tests/test_paged_kv.py``):
+
+- **Block 0 is the null block.** It is never allocated; every unmapped
+  table entry points at it, so device-side writes routed through the
+  table for idle/retired rows (and prefill scatter of positions past a
+  row's live length) land in dedicated trash that no masked read ever
+  uses. The device pool therefore has ``num_blocks + 1`` blocks for a
+  pool of capacity ``num_blocks``.
+- **Reserve at admit, map lazily.** Admission reserves the request's
+  whole worst-case block count up front (``reserve``), so a resident can
+  NEVER hit pool exhaustion mid-decode — no preemption/swap machinery,
+  and greedy output stays deterministic. Physical block ids are mapped
+  page-by-page as positions actually fill (``ensure``): prompt pages at
+  admit, one page per chunked-prefill call as the staged prefix grows,
+  and the next page when a decode step crosses a block boundary. The
+  free-list invariant ``free >= outstanding reservations`` makes the
+  lazy ``ensure`` infallible.
+- **Deterministic allocation order.** The free list is a min-heap;
+  allocation always hands out the lowest free block id and ``release``
+  returns ids to the heap — identical schedules produce identical block
+  tables (and therefore identical compiled-program inputs), which the
+  FakeClock-driven allocator drills rely on.
+- **Zero-leak accounting.** ``release`` frees both the mapped blocks and
+  the unconsumed reservation; ``in_use``/``reserved`` must both read 0
+  when the engine is idle. Fragmentation is structurally bounded: blocks
+  are fixed-size and interchangeable, so the only waste is internal
+  (the tail of the last block per request — at most ``block_size - 1``
+  positions per resident).
+
+Observability (docs/observability.md): the owning engine publishes
+``kv_pool_blocks`` / ``kv_pool_blocks_in_use`` / ``kv_pool_blocks_high_water``
+gauges and ``kv_pool_block_allocs_total`` / ``kv_pool_block_frees_total``
+counters from this allocator's accessors, plus the live
+``kv_cache_resident_bytes`` gauge (allocated pages, not the analytic
+worst case — that moved to ``kv_cache_capacity_bytes``).
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by :meth:`KVPagePool.reserve` when the request's worst-case
+    block count exceeds the currently unreserved pool — the engine's
+    admission gate catches it and leaves the request queued."""
+
+
+class KVPagePool:
+    """Host-side block allocator + per-slot block tables for one engine.
+
+    :param num_blocks: usable pool capacity in blocks (the null block is
+        extra; the device pool holds ``num_blocks + 1`` blocks).
+    :param block_size: token positions per block.
+    :param slots: number of persistent decode slots (block-table rows).
+    :param max_len: max token positions one slot can hold (the model
+        context length) — fixes the block-table width.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, slots: int, max_len: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        import numpy as np
+
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.slots = int(slots)
+        self.pages_per_slot = -(-int(max_len) // self.block_size)
+        # ids 1..num_blocks; 0 is the null block (see module docstring)
+        self._free: List[int] = list(range(1, self.num_blocks + 1))
+        heapq.heapify(self._free)
+        self._table = np.zeros((self.slots, self.pages_per_slot), np.int32)
+        self._mapped: Dict[int, List[int]] = {s: [] for s in range(self.slots)}
+        self._reserved: Dict[int, int] = {s: 0 for s in range(self.slots)}
+        self.high_water = 0
+        self.allocs_total = 0
+        self.frees_total = 0
+
+    # -- sizing -------------------------------------------------------------
+    def blocks_needed(self, tokens: int) -> int:
+        """Worst-case block count for a request holding ``tokens`` positions
+        (prompt + max_new for the slot engine's scope)."""
+        return -(-max(0, int(tokens)) // self.block_size)
+
+    @property
+    def in_use(self) -> int:
+        """Blocks currently mapped to a slot (physically allocated)."""
+        return self.num_blocks - len(self._free)
+
+    @property
+    def reserved(self) -> int:
+        """Blocks committed to residents: mapped plus not-yet-mapped
+        reservation balance. Admission must gate on this, not ``in_use`` —
+        lazily-mapped pages are already spoken for."""
+        return self.in_use + sum(self._reserved.values())
+
+    @property
+    def available(self) -> int:
+        return self.num_blocks - self.reserved
+
+    def can_reserve(self, blocks: int) -> bool:
+        return blocks <= self.available
+
+    # -- lifecycle ----------------------------------------------------------
+    def reserve(self, slot: int, tokens: int) -> int:
+        """Commit the worst-case block count for a request of ``tokens``
+        total positions to ``slot``; returns the count. Raises
+        :class:`PoolExhausted` when the pool cannot ever satisfy it right
+        now (the caller keeps the request queued) and ``ValueError`` on a
+        slot that already holds a reservation (engine bug, not load)."""
+        if self._reserved[slot] or self._mapped[slot]:
+            raise ValueError(f"slot {slot} already holds pool pages/reservation")
+        need = self.blocks_needed(tokens)
+        if need > self.pages_per_slot:
+            raise ValueError(
+                f"{tokens} tokens need {need} blocks but one slot maps at "
+                f"most {self.pages_per_slot}"
+            )
+        if not self.can_reserve(need):
+            raise PoolExhausted(
+                f"need {need} blocks, {self.available} of {self.num_blocks} "
+                "unreserved"
+            )
+        self._reserved[slot] = need
+        return need
+
+    def ensure(self, slot: int, tokens: int) -> bool:
+        """Map physical blocks for every page covering positions
+        ``[0, tokens)`` of ``slot``, consuming its reservation; returns True
+        when any new block was mapped (the caller refreshes gauges and the
+        device table). Infallible for positions within the reservation —
+        the free-list invariant guarantees a block is available."""
+        pages = self.blocks_needed(tokens)
+        mapped = self._mapped[slot]
+        changed = False
+        while len(mapped) < pages:
+            if self._reserved[slot] <= 0:
+                raise ValueError(
+                    f"slot {slot} mapping page {len(mapped)} past its "
+                    "reservation — admission accounting bug"
+                )
+            block = heapq.heappop(self._free)  # lowest id first: deterministic
+            self._reserved[slot] -= 1
+            self._table[slot, len(mapped)] = block
+            mapped.append(block)
+            self.allocs_total += 1
+            changed = True
+        if changed:
+            self.high_water = max(self.high_water, self.in_use)
+        return changed
+
+    def release(self, slot: int) -> int:
+        """Free ``slot``'s mapped blocks and drop its unconsumed
+        reservation (retire/failover/timeout all route here); returns the
+        number of blocks physically freed."""
+        mapped = self._mapped[slot]
+        freed = len(mapped)
+        for block in mapped:
+            heapq.heappush(self._free, block)
+        self.frees_total += freed
+        mapped.clear()
+        self._reserved[slot] = 0
+        self._table[slot, :] = 0
+        return freed
+
+    def release_all(self) -> int:
+        """Failover path: every slot's pages back to the free list."""
+        return sum(self.release(s) for s in range(self.slots))
+
+    # -- views --------------------------------------------------------------
+    def table(self):
+        """The ``(slots, pages_per_slot)`` int32 block table (a live view;
+        the engine copies it to device each step it changed)."""
+        return self._table
+
+    def table_row(self, slot: int):
+        return self._table[slot]
+
+    def mapped_blocks(self, slot: int) -> int:
+        return len(self._mapped[slot])
+
+    def leaked(self) -> int:
+        """Blocks neither free nor attributed to a slot — always 0 unless
+        the allocator itself is buggy (pinned by the leak drills)."""
+        return self.num_blocks - len(self._free) - sum(
+            len(m) for m in self._mapped.values()
+        )
+
+    def utilization(self) -> float:
+        return self.in_use / self.num_blocks
+
+    def stats(self) -> dict:
+        return {
+            "blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "pages_per_slot": self.pages_per_slot,
+            "in_use": self.in_use,
+            "reserved": self.reserved,
+            "high_water": self.high_water,
+            "allocs_total": self.allocs_total,
+            "frees_total": self.frees_total,
+            "utilization": round(self.utilization(), 4),
+        }

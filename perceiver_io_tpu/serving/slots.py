@@ -99,9 +99,11 @@ from perceiver_io_tpu.inference.generate import (
     _decode_forward,
     _decode_prefill,
     _decode_step_boundary,
+    _decode_step_boundary_paged,
     _prefill_chunk_kv,
     _prefill_finalize,
     _slot_decode_step,
+    _slot_decode_step_paged,
     cached_executor,
     executor_cache_stats,
     ledger_model_id,
@@ -109,7 +111,9 @@ from perceiver_io_tpu.inference.generate import (
     register_executor_cache,
 )
 from perceiver_io_tpu.inference.samplers import apply_min_new_tokens, sample_logits
+from perceiver_io_tpu.ops import paged_attention as paged_ops
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine, _round_ms
+from perceiver_io_tpu.serving.kv_pool import KVPagePool
 
 _EXECUTOR_CACHE: dict = register_executor_cache({})
 
@@ -153,42 +157,75 @@ def _prefill_shapes(model, params):
     return logits_s, cache_s
 
 
-def _blank_state(model, params, slots: int, pad_token_id: int) -> dict:
+def _blank_state(model, params, slots: int, pad_token_id: int,
+                 pool_tokens: Optional[int] = None) -> dict:
     """Zero-initialized persistent multi-slot decode state; KV-cache and
-    logits shapes/dtypes track the model's computation dtype."""
+    logits shapes/dtypes track the model's computation dtype.
+
+    ``pool_tokens`` selects the block-paged cross-KV layout
+    (docs/serving.md): instead of per-slot dense ``cross_k/cross_v`` rows
+    sized at the full context, the state holds ONE flat token-major pool
+    ``pool_k/pool_v`` of that many positions, addressed through the
+    engine's :class:`~perceiver_io_tpu.serving.kv_pool.KVPagePool` block
+    tables. The latent-stack caches stay dense either way — they scale
+    with ``max_latents`` (a model constant), not ``max_context``, so they
+    are not part of the ``slots × max_context`` term the pool breaks."""
     n = model.max_seq_len
     logits_s, cache_s = _prefill_shapes(model, params)
 
     def z(sds):
         return jnp.zeros((slots,) + tuple(sds.shape[1:]), sds.dtype)
 
-    return {
+    state = {
         "window": jnp.full((slots, n), pad_token_id, jnp.int32),
         "pad": jnp.full((slots,), n, jnp.int32),
         "length": jnp.zeros((slots,), jnp.int32),
         "m": jnp.zeros((slots,), jnp.int32),
         "steps": jnp.zeros((slots,), jnp.int32),
         "logits": z(logits_s),
-        "cross_k": z(cache_s["cross_k"]),
-        "cross_v": z(cache_s["cross_v"]),
         "stack_k": tuple(z(s) for s in cache_s["stack_k"]),
         "stack_v": tuple(z(s) for s in cache_s["stack_v"]),
     }
+    if pool_tokens is None:
+        state["cross_k"] = z(cache_s["cross_k"])
+        state["cross_v"] = z(cache_s["cross_v"])
+    else:
+        _, h, _, d = cache_s["cross_k"].shape
+        state["pool_k"] = jnp.zeros((pool_tokens, h, d), cache_s["cross_k"].dtype)
+        state["pool_v"] = jnp.zeros((pool_tokens, h, d), cache_s["cross_v"].dtype)
+    return state
 
 
-def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m):
+def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m,
+                table_row=None, block_size: Optional[int] = None):
     """Insert one prefilled row (batch-1 caches + row state) into slot
     ``slot`` of the persistent multi-slot state — shared by the per-bucket
     prefill executor and the chunked-prefill finalize so the two admission
-    paths cannot drift. ``slot`` and ``m`` may be traced scalars."""
+    paths cannot drift. ``slot`` and ``m`` may be traced scalars.
+
+    Under the paged layout (``table_row`` given) the row's dense batch-1
+    ``cross_k/cross_v`` scatter into the shared pool through the slot's
+    block table: live positions land on the slot's mapped blocks, positions
+    past them route to the null block (trash the masked attends never
+    read)."""
     def upd(dst, src):
         return jax.lax.dynamic_update_slice(
             dst, src.astype(dst.dtype), (slot,) + (0,) * (dst.ndim - 1)
         )
 
     new = dict(state)
-    new["cross_k"] = upd(state["cross_k"], cache["cross_k"])
-    new["cross_v"] = upd(state["cross_v"], cache["cross_v"])
+    if table_row is None:
+        new["cross_k"] = upd(state["cross_k"], cache["cross_k"])
+        new["cross_v"] = upd(state["cross_v"], cache["cross_v"])
+    else:
+        n = cache["cross_k"].shape[2]
+        flat = paged_ops.flat_position_indices(table_row, block_size, n)
+        new["pool_k"] = state["pool_k"].at[flat].set(
+            cache["cross_k"][0].transpose(1, 0, 2).astype(state["pool_k"].dtype)
+        )
+        new["pool_v"] = state["pool_v"].at[flat].set(
+            cache["cross_v"][0].transpose(1, 0, 2).astype(state["pool_v"].dtype)
+        )
     new["stack_k"] = tuple(
         upd(d, s) for d, s in zip(state["stack_k"], cache["stack_k"])
     )
@@ -204,13 +241,17 @@ def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m):
     return new
 
 
-def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int):
+def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int,
+                            block_size: Optional[int] = None):
     """Prefill one request at prompt bucket ``bucket_len`` and insert its
-    caches + row state into slot ``slot`` of the persistent state."""
+    caches + row state into slot ``slot`` of the persistent state.
+    ``block_size`` selects the paged layout: the executor additionally
+    takes the slot's block-table row and scatters the cross cache into the
+    shared pool instead of the dense slot row."""
     n = model.max_seq_len
     m0 = min(bucket_len, config.num_latents)
 
-    def run(params, ids, pad_count, slot, state):
+    def prefill(params, ids, pad_count):
         window = jnp.full((1, n), config.pad_token_id, ids.dtype)
         window = window.at[:, n - bucket_len:].set(ids)
         pad = pad_count.astype(jnp.int32) + (n - bucket_len)
@@ -218,15 +259,31 @@ def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int):
             {"params": params}, window, pad, jnp.asarray(m0, jnp.int32),
             method=_decode_prefill,
         )
+        return window, pad, logits, cache, length
+
+    if block_size is None:
+        def run(params, ids, pad_count, slot, state):
+            window, pad, logits, cache, length = prefill(params, ids, pad_count)
+            return _insert_row(
+                state, slot, window=window, pad=pad, logits=logits,
+                cache=cache, length=length, m=jnp.asarray(m0, jnp.int32),
+            )
+
+        return jax.jit(run, donate_argnums=_donate(4))
+
+    def run_paged(params, ids, pad_count, slot, table_row, state):
+        window, pad, logits, cache, length = prefill(params, ids, pad_count)
         return _insert_row(
             state, slot, window=window, pad=pad, logits=logits, cache=cache,
             length=length, m=jnp.asarray(m0, jnp.int32),
+            table_row=table_row, block_size=block_size,
         )
 
-    return jax.jit(run, donate_argnums=_donate(4))
+    return jax.jit(run_paged, donate_argnums=_donate(5))
 
 
-def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int):
+def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int,
+                                    block_size: Optional[int] = None):
     """ONE bucket-independent executor for chunked admission, two
     ``lax.cond`` branches in one compiled program. Stage calls project the
     ``kv_norm``-side cross k/v of ``chunk`` prefix token positions into a
@@ -246,7 +303,7 @@ def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int)
     (``len(prompt_buckets) + 2 -> + 3``, pinned by tests)."""
 
     def run(params, tokens, offset, is_final, window, pad_count, m, slot,
-            stage_k, stage_v, state):
+            table_row, stage_k, stage_v, state):
         def stage(ops):
             stage_k, stage_v, state = ops
             k_c, v_c = model.apply(
@@ -269,16 +326,22 @@ def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int)
             state = _insert_row(
                 state, slot, window=window, pad=pad_count, logits=logits,
                 cache=cache, length=length, m=m,
+                # paged layout: the finalized row's dense cross cache
+                # scatters into the pool through the slot's block table
+                # (live positions -> mapped blocks, the rest -> null block)
+                table_row=None if block_size is None else table_row,
+                block_size=block_size,
             )
             return stage_k, stage_v, state
 
         return jax.lax.cond(is_final, fin, stage, (stage_k, stage_v, state))
 
-    return jax.jit(run, donate_argnums=_donate(8, 9, 10))
+    return jax.jit(run, donate_argnums=_donate(9, 10, 11))
 
 
 def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
-                           boundary_mode: str = "cached"):
+                           boundary_mode: str = "cached",
+                           block_size: Optional[int] = None):
     """One fixed-shape token step over all slots: sample each row's next
     token from the resident logits, append it, advance every cache by one
     token. ``boundary=True`` additionally runs the boundary-phase step for
@@ -295,6 +358,80 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
     n = model.max_seq_len
     max_latents = model.max_latents
     min_new = config.min_new_tokens if config.eos_token_id is not None else 0
+
+    if block_size is not None:
+        # Paged layout: same per-token schedule, but the cross caches live
+        # in the shared block pool and the executor takes the (slots,
+        # pages) block table as a per-call traced argument — the host
+        # re-pushes it only when the allocator changed it, and no table
+        # content ever retraces this program. The dense executor's per-row
+        # ``where`` select between the base and boundary steps becomes
+        # write ROUTING (``write_ok``): each live pool position is written
+        # by exactly the step whose value the dense select would keep, so
+        # live rows' logits stay bitwise identical to the dense layout.
+        def run_paged(params, state, table, rng):
+            logits = state["logits"].astype(jnp.float32)
+            logits = apply_min_new_tokens(
+                logits, state["steps"][:, None], min_new, config.eos_token_id or 0
+            )
+            pad_positions = jnp.arange(n)[None, :] < state["pad"][:, None]
+            token = sample_logits(
+                rng, logits, config.sampling, state["window"], pad_positions
+            )
+            window = jnp.concatenate(
+                [state["window"][:, 1:], token[:, None].astype(state["window"].dtype)],
+                axis=1,
+            )
+            pad = jnp.maximum(state["pad"] - 1, 0)
+            length, m = state["length"], state["m"]
+            stack_cache = {
+                "stack_k": list(state["stack_k"]), "stack_v": list(state["stack_v"]),
+            }
+            is_b = m >= max_latents
+            write_ok = None
+            if boundary and boundary_mode == "cached":
+                write_ok = ~is_b  # boundary rows' appends belong to the
+                # boundary step below (dense select semantics)
+            logits_a, pool_k, pool_v, stack_a, _, _ = model.apply(
+                {"params": params}, token, state["pool_k"], state["pool_v"],
+                table, stack_cache, length, m, block_size, write_ok,
+                method=_slot_decode_step_paged,
+            )
+            new_logits = logits_a
+            stack_k, stack_v = stack_a["stack_k"], stack_a["stack_v"]
+            if boundary and boundary_mode == "recompute":
+                logits_b = model.apply(
+                    {"params": params}, window, pad,
+                    jnp.asarray(max_latents, jnp.int32),
+                    method=_decode_forward,
+                )
+                new_logits = jnp.where(is_b[:, None], logits_b, logits_a)
+            elif boundary:
+                logits_b, pool_k, pool_v, _ = model.apply(
+                    {"params": params}, window, pad, pool_k, pool_v, table,
+                    length, block_size, is_b,
+                    method=_decode_step_boundary_paged,
+                )
+                r4 = is_b[:, None, None, None]
+                new_logits = jnp.where(is_b[:, None], logits_b, logits_a)
+                # boundary rows' stack caches are stale by construction
+                # (the boundary step recomputes the whole stack); keep
+                # their old entries so latent rows' appends survive
+                stack_k = [jnp.where(r4, old, a) for old, a in zip(state["stack_k"], stack_k)]
+                stack_v = [jnp.where(r4, old, a) for old, a in zip(state["stack_v"], stack_v)]
+            new_state = {
+                "window": window,
+                "pad": pad,
+                "length": jnp.minimum(length + 1, n),  # idle slots saturate
+                "m": jnp.minimum(m + 1, max_latents),
+                "steps": state["steps"] + 1,
+                "logits": new_logits.astype(state["logits"].dtype),
+                "pool_k": pool_k, "pool_v": pool_v,
+                "stack_k": tuple(stack_k), "stack_v": tuple(stack_v),
+            }
+            return new_state, token
+
+        return jax.jit(run_paged, donate_argnums=_donate(1))
 
     def run(params, state, rng):
         logits = state["logits"].astype(jnp.float32)
@@ -426,12 +563,35 @@ class SlotServingEngine(ServingEngine):
         registry (cached when untuned). ``warmup()`` runs the autotuner
         first when set to ``"auto"`` explicitly, so one deployment measures
         once and every variant compiles against the winner.
+    :param kv_layout: cross-KV cache layout — ``"auto" | "dense" |
+        "paged"`` (docs/serving.md "Block-paged KV"). ``dense`` keeps
+        per-slot worst-case caches (the original layout); ``paged`` holds
+        ONE shared block pool + per-slot block tables, so HBM scales with
+        the pool size instead of ``slots × max_context`` and a long-tail
+        workload admits more residents at the same budget. Both layouts
+        are greedy token-identical (pinned by ``tests/test_paged_kv.py``).
+        ``None`` defers to ``PERCEIVER_KV_LAYOUT`` then the measured
+        registry (dense when untuned); an explicit ``"auto"`` makes
+        ``warmup()`` run the kv-layout autotuner and rebuild onto the
+        winner.
+    :param kv_block_size: token positions per pool block (paged layout;
+        default ``min(16, max_seq_len)``).
+    :param kv_blocks: usable pool capacity in blocks (the null block is
+        extra). Default sizes the pool at dense capacity
+        (``slots * ceil(max_seq_len / kv_block_size)``); size it BELOW
+        that to spend less HBM than dense while long-tail traffic still
+        fills every slot — requests whose worst case cannot currently fit
+        wait at the queue head (``kv_pool_admit_waits_total``), and
+        requests that could never fit reject at submit.
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
                  table=None, *, slots: int = 8,
                  prefill_chunk: Optional[int] = None,
-                 decode_strategy: Optional[str] = None, **kwargs):
+                 decode_strategy: Optional[str] = None,
+                 kv_layout: Optional[str] = None,
+                 kv_block_size: Optional[int] = None,
+                 kv_blocks: Optional[int] = None, **kwargs):
         super().__init__(
             model, params, config, table, decode_strategy=decode_strategy,
             **kwargs
@@ -440,6 +600,15 @@ class SlotServingEngine(ServingEngine):
             raise ValueError(f"slots must be >= 1, got {slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if kv_layout is not None and kv_layout not in decode_strategy_mod.KV_LAYOUTS:
+            raise ValueError(
+                f"kv_layout must be one of {decode_strategy_mod.KV_LAYOUTS}, "
+                f"got {kv_layout!r}"
+            )
+        if kv_block_size is not None and kv_block_size < 1:
+            raise ValueError(f"kv_block_size must be >= 1, got {kv_block_size}")
+        if kv_blocks is not None and kv_blocks < 1:
+            raise ValueError(f"kv_blocks must be >= 1, got {kv_blocks}")
         self.slots = int(slots)
         self.prefill_chunk = (
             None if prefill_chunk is None
@@ -451,26 +620,148 @@ class SlotServingEngine(ServingEngine):
             "serving_decode_rows_padded_total",
             "serving_prefills_total",
             "serving_prefill_chunks_total",
+            "kv_pool_block_allocs_total",
+            "kv_pool_block_frees_total",
+            "kv_pool_admit_waits_total",
         )
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._admitting: Optional[_ChunkedAdmit] = None
         self._pinned_boundary_mode: Optional[str] = None
-        self._state = _blank_state(model, params, self.slots, self.config.pad_token_id)
+        # -- KV layout (docs/serving.md "Block-paged KV") ------------------
+        # dense: per-slot worst-case cross caches (the original layout);
+        # paged: one shared block pool + per-slot block tables. Resolution
+        # mirrors the boundary strategy: explicit arg > PERCEIVER_KV_LAYOUT
+        # > measured registry > dense. An explicit "auto" re-resolves at
+        # warmup() after the kv-layout autotuner runs.
+        self.kv_layout_requested = kv_layout
+        #: True when the operator sized the pool explicitly — sizing IS a
+        #: layout choice, so a dense resolution would silently discard the
+        #: HBM budget the caller asked for; reject loudly instead, and skip
+        #: the warmup auto-switch (a dense verdict must not drop the budget)
+        self._kv_sized = kv_block_size is not None or kv_blocks is not None
+        self.kv_block_size = int(
+            min(kv_block_size or min(16, model.max_seq_len), model.max_seq_len)
+        )
+        #: usable pool capacity in blocks (null block excluded); default
+        #: matches the dense layout's capacity so un-tuned paged serving
+        #: admits exactly what dense would
+        self.kv_blocks = int(kv_blocks or self.slots * self._pages_per_slot())
+        resolved = decode_strategy_mod.resolve_kv_layout(kv_layout, model)
+        if self._kv_sized and resolved != "paged":
+            raise ValueError(
+                "kv_block_size/kv_blocks size the paged pool but the KV "
+                f"layout resolved to {resolved!r} — the budget would be "
+                "silently ignored; pass kv_layout='paged' (sizing the pool "
+                "is choosing the paged layout)"
+            )
+        self._kv_counter_base = {"allocs": 0, "frees": 0}
+        self._kv_waiting_id: Optional[int] = None  # last head counted waiting
+        self._init_kv_state(resolved)
         self._update_slot_gauges()
-        # analytic slot-KV footprint: the persistent cross/stack caches'
-        # byte size — exact on every backend, device memory_stats() or not
-        # (docs/observability.md, kv_cache_resident_bytes)
-        from perceiver_io_tpu.observability import default_ledger
 
-        kv_bytes = sum(
-            int(self._state[name].nbytes) for name in ("cross_k", "cross_v")
-        ) + sum(
+    def _pages_per_slot(self) -> int:
+        """Block-table width: pages covering one slot's full context."""
+        return -(-self.model.max_seq_len // self.kv_block_size)
+
+    def _pool_tokens(self) -> int:
+        """Device pool length in token positions: the usable blocks plus
+        block 0, the null/trash block (``serving/kv_pool.py``)."""
+        return (self.kv_blocks + 1) * self.kv_block_size
+
+    # -- KV state/pool lifecycle --------------------------------------------
+    def _init_kv_state(self, layout: str) -> None:
+        """(Re)build the persistent device state and host allocator for
+        ``layout`` ("dense" | "paged") and publish the capacity/resident
+        gauges. Also the warmup-time layout-switch path (an explicit
+        ``kv_layout="auto"`` re-resolving after the autotuner) — callers
+        must guarantee no residents."""
+        model, params = self.model, self.params
+        self.kv_layout = layout
+        if layout == "paged":
+            self._pool: Optional[KVPagePool] = KVPagePool(
+                self.kv_blocks, self.kv_block_size, self.slots, model.max_seq_len
+            )
+            self._state = _blank_state(
+                model, params, self.slots, self.config.pad_token_id,
+                pool_tokens=self._pool_tokens(),
+            )
+            self._table_dev = jnp.asarray(self._pool.table())
+        else:
+            self._pool = None
+            self._state = _blank_state(
+                model, params, self.slots, self.config.pad_token_id
+            )
+            self._table_dev = None
+        # analytic worst-case slot-KV footprint (the old
+        # kv_cache_resident_bytes meaning): dense per-slot cross caches at
+        # full context + the dense latent-stack caches — exact on every
+        # backend, device memory_stats() or not (docs/observability.md)
+        _, cache_s = _prefill_shapes(model, params)
+        _, h, n, d = cache_s["cross_k"].shape
+        itemsize = jnp.dtype(cache_s["cross_k"].dtype).itemsize
+        self._kv_token_bytes = 2 * h * d * itemsize  # k + v, per position
+        self._kv_stack_bytes = sum(
             int(leaf.nbytes)
             for name in ("stack_k", "stack_v")
             for leaf in self._state[name]
         )
-        self.registry.set_gauge("kv_cache_resident_bytes", kv_bytes)
-        default_ledger().set_kv_cache_bytes(kv_bytes)
+        self._kv_capacity_bytes = (
+            self.slots * n * self._kv_token_bytes + self._kv_stack_bytes
+        )
+        self.registry.set_gauge("kv_cache_capacity_bytes", self._kv_capacity_bytes)
+        if self._pool is not None:
+            self.registry.set_gauge("kv_pool_blocks", self._pool.num_blocks)
+            self.registry.set_gauge(
+                "kv_pool_block_bytes", self.kv_block_size * self._kv_token_bytes
+            )
+        self._update_kv_gauges()
+
+    def _update_kv_gauges(self) -> None:
+        """Publish the LIVE KV footprint: under the paged layout,
+        ``kv_cache_resident_bytes`` counts allocated pages (+ the dense
+        stack caches), updated on admit/retire/chunk progress; dense keeps
+        resident == capacity (every slot row exists whether occupied or
+        not). Pool gauges/counters ride along (docs/observability.md)."""
+        from perceiver_io_tpu.observability import default_ledger
+
+        pool = self._pool
+        if pool is None:
+            resident = self._kv_capacity_bytes
+        else:
+            resident = (
+                pool.in_use * self.kv_block_size * self._kv_token_bytes
+                + self._kv_stack_bytes
+            )
+            self.registry.set_gauge("kv_pool_blocks_in_use", pool.in_use)
+            self.registry.set_gauge("kv_pool_blocks_reserved", pool.reserved)
+            self.registry.set_gauge("kv_pool_blocks_high_water", pool.high_water)
+            base = self._kv_counter_base
+            if pool.allocs_total > base["allocs"]:
+                self.registry.inc(
+                    "kv_pool_block_allocs_total", pool.allocs_total - base["allocs"]
+                )
+                base["allocs"] = pool.allocs_total
+            if pool.frees_total > base["frees"]:
+                self.registry.inc(
+                    "kv_pool_block_frees_total", pool.frees_total - base["frees"]
+                )
+                base["frees"] = pool.frees_total
+        self.registry.set_gauge("kv_cache_resident_bytes", resident)
+        default_ledger().set_kv_cache_bytes(resident)
+
+    def _push_table(self) -> None:
+        """Refresh the device copy of the block table after the allocator
+        changed it (admit/chunk-progress/decode page crossing/retire). A
+        (slots, pages) int32 transfer — tiny next to a decode step."""
+        self._table_dev = jnp.asarray(self._pool.table())
+
+    def _kv_release(self, slot: int) -> None:
+        """Return a retired/failed slot's pages to the pool and refresh
+        gauges + device table."""
+        if self._pool is not None:
+            if self._pool.release(slot):
+                self._push_table()
+            self._update_kv_gauges()
 
     # -- executors -----------------------------------------------------------
     def _cache_key(self, kind: str, *extra):
@@ -480,9 +771,16 @@ class SlotServingEngine(ServingEngine):
         # it must NOT key the executors — requests overriding it share one
         # compiled program
         cfg = dataclasses.replace(self.config, max_new_tokens=0)
+        # the paged pool's device shape (blocks x block size) specializes
+        # every executor, so it must key them; dense keys stay identical to
+        # the pre-paged ones
+        kv = (
+            ("paged", self.kv_block_size, self.kv_blocks)
+            if self.kv_layout == "paged" else ()
+        )
         return (
             kind, type(self.model).__qualname__, model_fingerprint(self.model),
-            cfg, self.slots, trace_env_fingerprint(), *extra,
+            cfg, self.slots, trace_env_fingerprint(), *kv, *extra,
         )
 
     def _ledger_components(self, **extra) -> dict:
@@ -495,18 +793,28 @@ class SlotServingEngine(ServingEngine):
         from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
 
         cfg = dataclasses.replace(self.config, max_new_tokens=0)
-        return {
+        components = {
             "model": ledger_model_id(self.model),
             "config": cfg,
             "slots": self.slots,
             "trace_env": trace_env_fingerprint(),
             **extra,
         }
+        if self.kv_layout == "paged":
+            components["kv_layout"] = (
+                f"paged:{self.kv_blocks}x{self.kv_block_size}"
+            )
+        return components
+
+    def _kv_block_size_arg(self) -> Optional[int]:
+        return self.kv_block_size if self.kv_layout == "paged" else None
 
     def _prefill_executor(self, bucket_len: int):
         return cached_executor(
             _EXECUTOR_CACHE, self._cache_key("slot_prefill", bucket_len),
-            lambda: _build_prefill_executor(self.model, self.config, bucket_len),
+            lambda: _build_prefill_executor(
+                self.model, self.config, bucket_len, self._kv_block_size_arg()
+            ),
             ledger_site="slot_prefill",
             ledger_components=lambda: self._ledger_components(
                 bucket_shape=f"1x{bucket_len}"
@@ -518,7 +826,8 @@ class SlotServingEngine(ServingEngine):
             _EXECUTOR_CACHE,
             self._cache_key("slot_prefill_chunk", self.prefill_chunk),
             lambda: _build_chunked_prefill_executor(
-                self.model, self.config, self.prefill_chunk
+                self.model, self.config, self.prefill_chunk,
+                self._kv_block_size_arg(),
             ),
             ledger_site="slot_prefill_chunk",
             ledger_components=lambda: self._ledger_components(
@@ -547,7 +856,8 @@ class SlotServingEngine(ServingEngine):
         return cached_executor(
             _EXECUTOR_CACHE, self._cache_key("slot_decode", boundary, mode),
             lambda: _build_decode_executor(
-                self.model, self.config, boundary, mode
+                self.model, self.config, boundary, mode,
+                self._kv_block_size_arg(),
             ),
             ledger_site="slot_decode",
             ledger_components=lambda: self._ledger_components(
@@ -592,6 +902,31 @@ class SlotServingEngine(ServingEngine):
                 "shortest served prompt"
             )
         return cap
+
+    def check_feasible(self, prompt, config: Optional[GenerationConfig] = None
+                       ) -> GenerationConfig:
+        """Base feasibility plus KV-pool capacity (docs/serving.md): a
+        request whose worst case ``prompt + max_new_tokens`` can NEVER fit
+        the configured block pool rejects here — at submit, with its own
+        precise reason — instead of camping at the queue head forever. A
+        request that fits the pool but not its current free space is NOT
+        rejected; it queues and admits when residents retire (counted
+        ``kv_pool_admit_waits_total``)."""
+        import numpy as np
+
+        cfg = super().check_feasible(prompt, config)
+        if self._pool is not None:
+            tokens = int(np.asarray(prompt).size) + cfg.max_new_tokens
+            need = self._pool.blocks_needed(tokens)
+            if need > self._pool.num_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks ({tokens} positions at "
+                    f"block size {self._pool.block_size}) but the pool holds "
+                    f"{self._pool.num_blocks}: it can never be admitted — "
+                    "raise kv_blocks (--serve.kv_blocks) or route it to the "
+                    "dense layout / bucket engine"
+                )
+        return cfg
 
     # -- slot lifecycle ------------------------------------------------------
     def _update_slot_gauges(self) -> None:
@@ -643,10 +978,24 @@ class SlotServingEngine(ServingEngine):
         # not queue wait
         req.started_at = t0
         self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
-        self._state = executor(
-            self.params, jnp.asarray(ids), jnp.asarray(pad),
-            np.int32(slot), self._state,
-        )
+        if self._pool is not None:
+            # the scheduler's admission gate verified capacity; reserve the
+            # worst case and map the prompt's pages (decode steps map the
+            # rest page-by-page as positions fill)
+            self._pool.reserve(slot, int(req.prompt.size) + cfg.max_new_tokens)
+            self._pool.ensure(slot, int(req.prompt.size))
+            self._push_table()
+            self._update_kv_gauges()
+            self._state = executor(
+                self.params, jnp.asarray(ids), jnp.asarray(pad),
+                np.int32(slot), jnp.asarray(self._pool.table_row(slot)),
+                self._state,
+            )
+        else:
+            self._state = executor(
+                self.params, jnp.asarray(ids), jnp.asarray(pad),
+                np.int32(slot), self._state,
+            )
         # fetch one (tiny) output leaf: the executor is a single XLA program,
         # so this fences the whole prefill — without it, async dispatch (TPU)
         # would record ~0 here and bleed the real prefill cost into the next
@@ -694,6 +1043,11 @@ class SlotServingEngine(ServingEngine):
         t0 = self._clock()
         req.started_at = t0
         self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
+        if self._pool is not None:
+            # worst-case reservation up front (the admission gate checked
+            # capacity); pages map chunk-by-chunk as the staged prefix grows
+            self._pool.reserve(slot, L + cfg.max_new_tokens)
+            self._update_kv_gauges()
         self._admitting = _ChunkedAdmit(
             req=req, slot=slot, bucket_len=bucket_len, m0=m0,
             window=window, pad=np.asarray([n - L], np.int32),
@@ -720,11 +1074,24 @@ class SlotServingEngine(ServingEngine):
         off = 0 if final else admit.offsets[i]
         tokens = jnp.asarray(admit.by_index[off:off + C][None, :])
         executor = self._chunked_prefill_executor()
+        if self._pool is not None:
+            # "allocated on chunked-prefill progress": map the pages this
+            # call's positions cover — every staged chunk extends the live
+            # footprint; the finalize needs the whole prompt mapped before
+            # its pool scatter
+            L = int(req.prompt.size)
+            covered = L if final else min(off + C, L)
+            if self._pool.ensure(admit.slot, covered):
+                self._push_table()
+            self._update_kv_gauges()
+            table_row = jnp.asarray(self._pool.table_row(admit.slot))
+        else:
+            table_row = jnp.zeros((self._pages_per_slot(),), jnp.int32)
         t0 = self._clock()
         admit.stage_k, admit.stage_v, self._state = executor(
             self.params, tokens, np.int32(off), np.bool_(final),
             jnp.asarray(admit.window), jnp.asarray(admit.pad),
-            np.int32(admit.m0), np.int32(admit.slot),
+            np.int32(admit.m0), np.int32(admit.slot), table_row,
             admit.stage_k, admit.stage_v, self._state,
         )
         # fence the call (host value fetch — same sync discipline as the
@@ -778,6 +1145,7 @@ class SlotServingEngine(ServingEngine):
             entry.req.result = out
         self._finish(entry.req, status, error=error)
         self._slots[entry.slot] = None
+        self._kv_release(entry.slot)
         if self.tracer is not None:
             self.tracer.event(
                 "serving.slot_retired", trace_id=entry.req.trace_id,
@@ -791,8 +1159,16 @@ class SlotServingEngine(ServingEngine):
         for entry in self._active():
             self._retire(entry, "failed", error=error)
             failed += 1
+        if self._pool is not None:
+            self._pool.release_all()
+            self._push_table()
+            self._update_kv_gauges()
+            pool_tokens = self._pool_tokens()
+        else:
+            pool_tokens = None
         self._state = _blank_state(
-            self.model, self.params, self.slots, self.config.pad_token_id
+            self.model, self.params, self.slots, self.config.pad_token_id,
+            pool_tokens=pool_tokens,
         )
         self._update_slot_gauges()
         return failed
@@ -824,6 +1200,7 @@ class SlotServingEngine(ServingEngine):
             req = admit.req
             if req.deadline_at is not None and now >= req.deadline_at:
                 self._admitting = None
+                self._kv_release(admit.slot)
                 self._finish(
                     req, "timed_out",
                     error=f"deadline exceeded after {admit.next_chunk} of "
@@ -841,6 +1218,7 @@ class SlotServingEngine(ServingEngine):
                     # state was donated into the failed call too, and a
                     # finalize fault wrote into it on every backend
                     self._admitting = None
+                    self._kv_release(admit.slot)
                     self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
                     disposed += 1
                     if final or _donate(0):
@@ -853,6 +1231,22 @@ class SlotServingEngine(ServingEngine):
             if slot is None:
                 break
             head = self._queue[0]
+            if self._pool is not None:
+                # pool admission gate: the head waits (FIFO — later
+                # requests must not starve it) until retirements free its
+                # worst-case block count. check_feasible already rejected
+                # requests that could NEVER fit, so this wait terminates.
+                # Counted once per WAITING REQUEST, not per scheduler poll
+                # (a long-blocked head is one wait, however many steps it
+                # spans).
+                need = self._pool.blocks_needed(
+                    int(head.prompt.size) + head.config.max_new_tokens
+                )
+                if not self._pool.can_reserve(need):
+                    if self._kv_waiting_id != head.request_id:
+                        self._kv_waiting_id = head.request_id
+                        self.registry.inc("kv_pool_admit_waits_total")
+                    break
             try:
                 chunked = self._chunk_eligible(head)
             except Exception:
@@ -874,6 +1268,7 @@ class SlotServingEngine(ServingEngine):
                     # first chunk: staging-only fault on CPU; with donation
                     # live the slot state went into the failed call too
                     self._admitting = None
+                    self._kv_release(slot)
                     self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
                     disposed += 1
                     if _donate(0):
@@ -906,10 +1301,26 @@ class SlotServingEngine(ServingEngine):
             # step: this step (dispatch + host-sync fence) runs under the
             # profiler capture; the step-number read (a registry lock) only
             # happens when a capture actually fires
+            if self._pool is not None:
+                # map the page each active row's NEXT write lands on (a
+                # block-boundary crossing maps one fresh block; reservation
+                # makes this infallible), then refresh the device table
+                changed = False
+                for entry in active:
+                    next_len = int(entry.req.prompt.size) + len(entry.emitted) + 1
+                    changed |= self._pool.ensure(entry.slot, next_len)
+                if changed:
+                    self._push_table()
+                    self._update_kv_gauges()
             with self._device_capture(
                 step=lambda: int(self.registry.counter("serving_decode_steps_total"))
             ):
-                self._state, tokens = executor(self.params, self._state, key)
+                if self._pool is not None:
+                    self._state, tokens = executor(
+                        self.params, self._state, self._table_dev, key
+                    )
+                else:
+                    self._state, tokens = executor(self.params, self._state, key)
                 tokens = np.asarray(tokens)  # host sync: the scheduling point
         except Exception as e:
             self.registry.observe(
@@ -989,18 +1400,45 @@ class SlotServingEngine(ServingEngine):
         before = executor_cache_stats()["misses"]
         if self.decode_strategy == "auto":
             decode_strategy_mod.autotune_boundary(self.model, self.params)
+        if self.kv_layout_requested == "auto" and not self._kv_sized:
+            # measure dense-vs-paged decode at the bound shape once per
+            # process (memoized; the probe's own executor compiles count in
+            # the return value), then rebuild onto the winner BEFORE
+            # compiling the grid — no residents here, so the switch is
+            # free. Skipped when the operator sized the pool explicitly:
+            # sizing is a layout choice, and a dense verdict would discard
+            # the budget. The probe engines published THEIR footprints on
+            # the process-global ledger gauge, so re-publish ours after.
+            verdict = decode_strategy_mod.autotune_kv_layout(
+                self.model, self.params, block_size=self.kv_block_size,
+            )
+            if verdict != self.kv_layout:
+                self._init_kv_state(verdict)
+            else:
+                self._update_kv_gauges()
         # no residents here (checked above), so re-resolving is safe: the
         # boundary variant compiles against the freshest verdict
         self._pinned_boundary_mode = None
+        paged = self._pool is not None
+        pages = self._pages_per_slot()
+        # an all-zero table routes every warmup write to the null block and
+        # every gather to its (finite) trash — the executors trace the same
+        # programs live traffic dispatches
+        row0 = jnp.zeros((pages,), jnp.int32)
         max_prefix = self.model.max_prefix_len
         for bucket_len in self.table.prompt_lens:
             if bucket_len - min(bucket_len, cfg.num_latents) > max_prefix:
                 continue
             ids = jnp.full((1, bucket_len), cfg.pad_token_id, jnp.int32)
             pad = jnp.zeros((1,), jnp.int32)
-            self._state = self._prefill_executor(bucket_len)(
-                self.params, ids, pad, np.int32(0), self._state
-            )
+            if paged:
+                self._state = self._prefill_executor(bucket_len)(
+                    self.params, ids, pad, np.int32(0), row0, self._state
+                )
+            else:
+                self._state = self._prefill_executor(bucket_len)(
+                    self.params, ids, pad, np.int32(0), self._state
+                )
         if self.prefill_chunk is not None:
             n = self.model.max_seq_len
             _, cache_s = _prefill_shapes(self.model, self.params)
@@ -1014,15 +1452,22 @@ class SlotServingEngine(ServingEngine):
             for final in (False, True):  # one program: lax.cond traces both
                 sk, sv, self._state = executor(
                     self.params, tokens, np.int32(0), np.bool_(final),
-                    window, pad, m0, np.int32(0), sk, sv, self._state,
+                    window, pad, m0, np.int32(0), row0, sk, sv, self._state,
                 )
         for boundary in (False, True):
             self._rng, key = jax.random.split(self._rng)
-            self._state, _ = self._decode_executor(boundary)(
-                self.params, self._state, key
-            )
+            if paged:
+                table0 = jnp.zeros((self.slots, pages), jnp.int32)
+                self._state, _ = self._decode_executor(boundary)(
+                    self.params, self._state, table0, key
+                )
+            else:
+                self._state, _ = self._decode_executor(boundary)(
+                    self.params, self._state, key
+                )
         self._state = _blank_state(
-            self.model, self.params, self.slots, cfg.pad_token_id
+            self.model, self.params, self.slots, cfg.pad_token_id,
+            pool_tokens=self._pool_tokens() if paged else None,
         )
         return executor_cache_stats()["misses"] - before
 
@@ -1052,7 +1497,17 @@ class SlotServingEngine(ServingEngine):
                 "p95": _round_ms(reg.percentile("serving_prefill_chunk_ms", 95.0)),
             },
             "decode_strategy_boundary": self._boundary_mode(),
+            "kv_layout": self.kv_layout,
         })
+        if self._pool is not None:
+            out["kv_pool"] = {
+                **self._pool.stats(),
+                "admit_waits": int(counts.get("kv_pool_admit_waits_total", 0)),
+                "resident_bytes": int(
+                    self.registry.gauge("kv_cache_resident_bytes") or 0
+                ),
+                "capacity_bytes": self._kv_capacity_bytes,
+            }
         return out
 
     def health(self) -> dict:
@@ -1060,4 +1515,5 @@ class SlotServingEngine(ServingEngine):
         out["slots"] = self.slots
         out["slots_active"] = sum(1 for s in self._slots if s is not None)
         out["admitting"] = self._admitting is not None
+        out["kv_layout"] = self.kv_layout
         return out

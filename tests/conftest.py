@@ -57,6 +57,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "paged_kv: block-paged KV pool + ragged paged decode attention test "
+        "(serving/kv_pool.py, serving/slots.py, ops/paged_attention.py; "
+        "docs/serving.md); CPU-fast, runs in the tier-1 suite",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )
