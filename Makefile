@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos obs obs-report decode-strategy decode-tune cov bench serve-bench paged-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos obs obs-report slo slo-bench decode-strategy decode-tune cov bench serve-bench paged-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -30,6 +30,27 @@ obs:
 obs-report:
 	$(PY) -m perceiver_io_tpu.observability.report tests/fixtures/events.jsonl \
 		--snapshot tests/fixtures/metrics_snapshot.json
+
+# SLO telemetry suite (docs/observability.md): burn-rate monitor drills,
+# load-generator determinism, TTFT/ITL accounting, fleet admission
+# tightening — CPU-fast, also tier-1
+slo:
+	$(PY) -m pytest tests/ -q -m slo --continue-on-collection-errors
+
+# goodput-under-SLO sweep at the CPU-fallback shape (docs/observability.md):
+# offered-load sweep through the slot engine via the Poisson load generator,
+# printing p95 TTFT / p95 inter-token latency per point and the knee
+slo-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'slo_goodput': bench._bench_slo_goodput(model, params, cfg)}, indent=2))"
 
 # decode-strategy suite (per-phase cached-vs-recompute + chunked prefill;
 # docs/serving.md, docs/benchmarks.md) — CPU-fast, also tier-1

@@ -600,6 +600,21 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "observability": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: goodput-under-SLO sweep (offered load vs p95 TTFT/ITL) ----
+        if left() > 120.0:
+            log("run: slo-goodput sweep (offered load vs p95 TTFT / inter-token)")
+            try:
+                slo = _bench_slo_goodput(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "slo_goodput": slo})
+                log(f"run: slo-goodput knee at {slo['knee']['offered_rps']} rps "
+                    f"offered ({slo['knee']['goodput_rps']} rps good, factor "
+                    f"{slo['knee']['rate_factor']}x; report matches registry: "
+                    f"{slo['report_percentiles_match_registry']})")
+            except Exception as e:
+                log(f"run: slo-goodput sweep failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "slo_goodput": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # BENCH_* records carry the process-wide telemetry snapshot AND the
         # device-cost ledger (per-executor compile/memory/retrace table;
         # docs/observability.md) — every BENCH_* file is `obs report`-able.
@@ -1435,11 +1450,19 @@ def _bench_fleet_chaos(model, params, cfg, *, n_requests: int = 8,
         r.status == "ok" and np.array_equal(r.result, want)
         for r, want in zip(reqs, reference)
     )
+    from perceiver_io_tpu.observability import goodput_ratio, offered_load
+
+    fleet_counts = fleet.registry.counters()
     return {
         "replicas": replicas,
         "submitted": n_requests,
         "completed": completed,
         "completion_ratio": round(completed / n_requests, 4),
+        # the shared goodput definition (observability/slo.py): completed /
+        # offered (accepted + shed + rejected) — same helper as the
+        # observability and slo_goodput probes
+        "offered": offered_load(fleet_counts, "fleet"),
+        "goodput_ratio": round(goodput_ratio(fleet_counts, "fleet"), 4),
         "failovers": s["failovers"],
         "redispatches": s["redispatches"],
         "replica_restarts": s["replica_restarts"],
@@ -1511,11 +1534,11 @@ def _bench_observability(model, params, cfg, *, n_requests: int = 12,
     terminal: dict = {}
     for sp in tracer.spans("serving.request"):
         terminal[sp.status] = terminal.get(sp.status, 0) + 1
-    # goodput denominator is OFFERED load (accepted + shed + rejected), per
-    # the "completed vs shed+timed_out+failed" definition — an engine that
-    # sheds half its traffic must not report goodput 1.0
-    offered = s["requests"] + s["shed"] + s["rejected"]
-    goodput = s["completed"] / max(1, offered)
+    # goodput denominator is OFFERED load (accepted + shed + rejected) —
+    # the ONE shared definition (observability/slo.py), also used by the
+    # fleet-chaos and slo-goodput probes so the three cannot drift
+    from perceiver_io_tpu.observability import goodput_ratio
+    goodput = goodput_ratio(registry.counters())
     tokens_per_sec = s["tokens_generated"] / wall
 
     n_params = sum(
@@ -1544,6 +1567,159 @@ def _bench_observability(model, params, cfg, *, n_requests: int = 12,
         "requests": n_requests,
         "new_tokens": new_tokens,
         "snapshot": snap,
+    }
+
+
+def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
+                       new_tokens: int = 6, slots: int = 4,
+                       rate_factors=(0.5, 1.0, 2.0)):
+    """Goodput-under-SLO sweep (docs/observability.md): offered load vs
+    p95 TTFT / p95 inter-token latency through the slot engine, driven by
+    the open-loop Poisson load generator — the serving-paper measurement
+    surface (PAPERS.md [1]) as a bench probe.
+
+    A closed-loop calibration run at full slot concurrency estimates the
+    engine's capacity (completed req/s) and the healthy-load latency
+    percentiles; the SLO targets are set at 3x those (generous headroom a
+    saturated point still blows through). The sweep then offers Poisson
+    load at ``rate_factors`` x capacity. Per point: the registry's p95
+    TTFT/ITL, completed rate, and **goodput under SLO** — requests/s that
+    completed AND met the TTFT target per-request (joined from their
+    ``serving.first_token`` events) at a point whose aggregate p95 ITL
+    also met target. The knee is the point of max goodput: past it,
+    added offered load only grows latency. The probe also cross-checks
+    that ``obs report``'s SLO section reproduces the registry's
+    nearest-rank percentiles exactly (the acceptance pin).
+
+    All accounting uses the shared offered-load goodput definition
+    (``observability/slo.py``) — the same helper the fleet-chaos and
+    observability probes use, so the denominators cannot drift."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.observability import (
+        LoadGenerator,
+        MetricsRegistry,
+        Tracer,
+        WorkloadSpec,
+        goodput_ratio,
+    )
+    from perceiver_io_tpu.observability import report as obs_report
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    num_latents = min(4, cfg.max_latents)
+    max_len = min(
+        16, cfg.max_seq_len - new_tokens,
+        cfg.max_seq_len - cfg.max_latents + num_latents,
+    )
+    table = BucketTable(prompt_lens=(max_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    workload = WorkloadSpec(
+        prompt_len=(max(num_latents, max_len // 2), max_len),
+        max_new_tokens=(max(2, new_tokens // 2), new_tokens),
+        vocab=(1, cfg.vocab_size),
+    )
+
+    def run_point(rate_rps, mode, seed):
+        registry = MetricsRegistry()
+        tracer = Tracer()
+        engine = SlotServingEngine(
+            model, params, gcfg, table, slots=slots,
+            registry=registry, tracer=tracer, rng=jax.random.PRNGKey(2),
+        )
+        gen = LoadGenerator(
+            engine, workload=workload, mode=mode, arrival="poisson",
+            rate_rps=rate_rps, users=slots, max_requests=requests_per_rate,
+            config=gcfg, rng=seed,
+        )
+        return registry, tracer, gen, gen.run()
+
+    # warm every executor once up front — the sweep measures serving, not
+    # compiles (caches are process-global, so later engines reuse them)
+    SlotServingEngine(model, params, gcfg, table, slots=slots).warmup()
+
+    # calibration: closed loop at full slot concurrency = capacity estimate
+    reg_c, _, _, rep_c = run_point(1.0, "closed", seed=0)
+    base_rps = max(rep_c["completed_rps"], 0.1)
+    cal_ttft = reg_c.percentile("serving_ttft_ms", 95.0) or 1.0
+    cal_itl = reg_c.percentile("serving_inter_token_ms", 95.0) or 1.0
+    slo_ttft_ms = round(3.0 * cal_ttft, 3)
+    slo_itl_ms = round(3.0 * cal_itl, 3)
+
+    sweep = []
+    report_matches = True
+    for factor in rate_factors:
+        rate = base_rps * factor
+        registry, tracer, gen, rep = run_point(rate, "open", seed=1)
+        p95_ttft = registry.percentile("serving_ttft_ms", 95.0)
+        p95_itl = registry.percentile("serving_inter_token_ms", 95.0)
+        itl_ok = p95_itl is not None and p95_itl <= slo_itl_ms
+        ttft_by_trace = {
+            sp.trace_id: sp.attrs.get("ttft_ms")
+            for sp in tracer.spans("serving.first_token")
+        }
+        good = sum(
+            1 for h in gen.handles
+            if h.status == "ok"
+            and (ttft_by_trace.get(h.trace_id) or float("inf")) <= slo_ttft_ms
+        ) if itl_ok else 0
+        # the acceptance pin: obs report's SLO section over this point's
+        # own artifacts reproduces the registry's nearest-rank percentiles
+        snap = registry.snapshot()
+        slo_sec = obs_report.analyze(
+            [sp.to_row() for sp in tracer.spans()],
+            {"histograms": snap["histograms"], "counters": snap["counters"]},
+        )["slo"]
+        report_matches = report_matches and (
+            slo_sec["ttft"]["p95_ms"] == (
+                None if p95_ttft is None else round(p95_ttft, 6)
+            )
+            and slo_sec["inter_token"]["p95_ms"] == (
+                None if p95_itl is None else round(p95_itl, 6)
+            )
+        )
+        sweep.append({
+            "rate_factor": factor,
+            "offered_rps_target": round(rate, 3),
+            "offered_rps": rep["offered_rps"],
+            "offered": rep["offered"],
+            "completed": rep["completed"],
+            "shed": rep["shed"],
+            "completed_rps": rep["completed_rps"],
+            "p95_ttft_ms": None if p95_ttft is None else round(p95_ttft, 3),
+            "p95_inter_token_ms": (
+                None if p95_itl is None else round(p95_itl, 3)
+            ),
+            "slo_met_aggregate": bool(
+                itl_ok and p95_ttft is not None and p95_ttft <= slo_ttft_ms
+            ),
+            "goodput_rps": round(good / rep["span_s"], 4),
+            "goodput_ratio": round(goodput_ratio(registry.counters()), 4),
+        })
+    knee_idx = max(
+        range(len(sweep)), key=lambda i: (sweep[i]["goodput_rps"], -i)
+    )
+    return {
+        "slots": slots,
+        "requests_per_rate": requests_per_rate,
+        "slo": {"ttft_p95_ms": slo_ttft_ms, "inter_token_p95_ms": slo_itl_ms},
+        "calibration": {
+            "base_rps": round(base_rps, 3),
+            "p95_ttft_ms": round(cal_ttft, 3),
+            "p95_inter_token_ms": round(cal_itl, 3),
+        },
+        "sweep": sweep,
+        "knee": {
+            "index": knee_idx,
+            "rate_factor": sweep[knee_idx]["rate_factor"],
+            "offered_rps": sweep[knee_idx]["offered_rps"],
+            "goodput_rps": sweep[knee_idx]["goodput_rps"],
+        },
+        "report_percentiles_match_registry": report_matches,
     }
 
 

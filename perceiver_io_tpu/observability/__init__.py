@@ -51,10 +51,18 @@ from perceiver_io_tpu.observability.ledger import (
     LedgeredExecutor,
     default_ledger,
 )
+from perceiver_io_tpu.observability.loadgen import LoadGenerator, WorkloadSpec
 from perceiver_io_tpu.observability.registry import (
     Histogram,
     MetricsRegistry,
     default_registry,
+)
+from perceiver_io_tpu.observability.slo import (
+    SLOArgs,
+    SLOMonitor,
+    SLOPolicy,
+    goodput_ratio,
+    offered_load,
 )
 from perceiver_io_tpu.observability.tracing import (
     JsonlSpanSink,
@@ -87,6 +95,12 @@ class ObservabilityArgs:
     #: path (slot-engine ``serving_decode_step_ms`` / bucket-engine
     #: ``serving_device_execute_ms``) and captures the next dispatch
     profile_on_regress_factor: Optional[float] = None
+    #: the ``--obs.slo.*`` sub-group: SLO targets (p95 TTFT / p95 ITL /
+    #: error rate) plus burn-window knobs. Setting any target builds an
+    #: :class:`SLOMonitor` for the serve run (docs/observability.md) —
+    #: burn-rate gauges, breach counters/events, profiler-trigger arming,
+    #: and (with ``--serve.replicas > 1``) tightened fleet admission.
+    slo: SLOArgs = dataclasses.field(default_factory=SLOArgs)
 
 
 __all__ = [
@@ -95,16 +109,23 @@ __all__ = [
     "Histogram",
     "JsonlSpanSink",
     "LedgeredExecutor",
+    "LoadGenerator",
     "MetricsRegistry",
     "ObservabilityArgs",
     "ProfilerTrigger",
+    "SLOArgs",
+    "SLOMonitor",
+    "SLOPolicy",
     "SnapshotWriter",
     "Span",
     "Tracer",
+    "WorkloadSpec",
     "default_ledger",
     "default_registry",
+    "goodput_ratio",
     "help_text",
     "normalize_row",
+    "offered_load",
     "read_events_jsonl",
     "read_metrics_jsonl",
     "snapshot_json",

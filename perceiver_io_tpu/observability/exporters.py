@@ -59,6 +59,11 @@ HELP_TEXT = {
     "serving_prefill_chunks": "Staging chunks per chunked admission.",
     "serving_slots_active": "Slots holding a resident request right now.",
     "serving_slots_idle": "Slots free for admission right now.",
+    "serving_ttft_ms": "Time to first token per request: submit (fleet front door when fleeted) to first generated token.",
+    "serving_inter_token_ms": "Inter-token latency: gap between a resident request's consecutive tokens (batch-amortized on the bucket engine).",
+    "slo_breach_total": "SLO burn-rate breaches entered (any dimension; see slo_breach_<dim>_total).",
+    "slo_recoveries_total": "SLO breach recoveries (fast-window burn back under threshold).",
+    "slo_burn_rate": "Worst sustained SLO burn rate across dimensions (min of fast/slow windows).",
     "serving_throughput_tokens_per_sec": "Serving throughput gauge (bench probe).",
     "serving_goodput_ratio": "Completed / offered requests (bench probe).",
     "serving_mfu": "Serving model-FLOPs utilization gauge (bench probe).",
@@ -70,7 +75,16 @@ HELP_TEXT = {
     "retrace_total": "Rebuilds of a logically-same executor (see retrace_reason_*).",
     "compile_ledger_fallback_total": "Executors demoted from AOT ledger dispatch to plain jit.",
     "hbm_bytes_in_use": "Live device memory from memory_stats() (absent on CPU).",
-    "kv_cache_resident_bytes": "Analytic byte size of the persistent slot KV caches.",
+    "kv_cache_resident_bytes": "Live slot-KV bytes: allocated pages + latent-stack caches under the paged layout; equals capacity when dense.",
+    "kv_cache_capacity_bytes": "Worst-case slot-KV bytes: dense per-slot caches at full context + latent-stack caches.",
+    "kv_pool_blocks": "Usable KV pool capacity in blocks (null block excluded).",
+    "kv_pool_blocks_in_use": "Pool blocks currently mapped to live token positions.",
+    "kv_pool_blocks_reserved": "Pool blocks reserved by resident requests' worst cases (mapped or not).",
+    "kv_pool_blocks_high_water": "Peak pool blocks in use over the engine lifetime.",
+    "kv_pool_block_bytes": "Bytes per pool block (block_size positions x per-position k+v).",
+    "kv_pool_block_allocs_total": "Pool block map operations (admit, chunk progress, decode page crossings).",
+    "kv_pool_block_frees_total": "Pool blocks returned on retire/failure.",
+    "kv_pool_admit_waits_total": "Requests that waited at the queue head for pool blocks to free.",
     "executor_resident_bytes": "Sum of recorded executors' temp+output bytes (XLA memory analysis).",
     "trainer_steps_total": "Executed optimizer steps (skipped steps included).",
     "trainer_skipped_steps_total": "Steps discarded by the non-finite skip policy.",
@@ -93,6 +107,7 @@ HELP_TEXT = {
     "fleet_replica_failures_total": "Replica failures observed (crash, hang, dispatch fault).",
     "fleet_replica_restarts_total": "Replica rebuilds (crash recovery or rolling restart).",
     "fleet_duplicate_results_total": "Late duplicate completions absorbed by exactly-once dedupe.",
+    "fleet_slo_shed_total": "Sheds caused by SLO-tightened admission (also counted in fleet_requests_shed_total).",
     "fleet_replicas": "Replicas owned by the fleet router.",
     "fleet_replicas_healthy": "Replicas with a closed circuit breaker right now.",
     "fleet_request_latency_ms": "Fleet request latency: submit to terminal state (failovers included).",
@@ -102,6 +117,8 @@ HELP_TEXT = {
 #: StepTimer gauges) — first hit wins
 _HELP_PREFIXES = (
     ("retrace_reason_", "Retraces attributed to this changed cache-key component."),
+    ("slo_burn_rate_", "Per-dimension SLO burn rate over one window (bad fraction / error budget)."),
+    ("slo_breach_", "SLO breaches entered on this dimension."),
 )
 
 

@@ -1,0 +1,310 @@
+"""Synthetic-user load generator: offered-load drills against the serving
+stack, deterministic end to end.
+
+The SLO layer (``observability/slo.py``, docs/observability.md) judges
+serving by latency percentiles *vs offered load* — which needs a load
+source with controlled arrival statistics, not "submit everything then
+drain". This module is that source: a :class:`LoadGenerator` drives any
+object exposing the shared request surface (``submit`` / ``step`` /
+``pending`` — both engines and the :class:`~perceiver_io_tpu.serving.FleetRouter`)
+with synthetic users.
+
+Two loop disciplines (both standard in serving evaluation — PAPERS.md's
+Gemma-on-TPU comparison sweeps offered load open-loop):
+
+- **Open loop** — arrivals come from an arrival process regardless of
+  completions, so a saturated engine builds queue instead of silently
+  back-pressuring the generator (the failure mode closed-loop-only
+  benchmarks hide). Processes: ``poisson`` (exponential inter-arrivals at
+  ``rate_rps``), ``bursty`` (bursts of ``burst_size`` back to back, burst
+  starts Poisson at ``rate_rps / burst_size``), ``ramp`` (rate ramps
+  linearly from ``rate_rps`` to ``ramp_to_rps`` across the run), and
+  ``uniform`` (fixed spacing — the deterministic baseline).
+- **Closed loop** — ``users`` synthetic users each keep one request in
+  flight: submit, await completion, think
+  (``workload.think_time_s``), resubmit. Offered load self-limits to
+  completion rate — the drill for per-user latency under steady
+  concurrency.
+
+Determinism: every random draw (arrival gaps, prompt lengths, prompt
+tokens, ``max_new_tokens``, think times) comes from ONE injected
+``numpy`` generator, and all timing runs on the injectable clock. Under a
+:class:`~perceiver_io_tpu.reliability.FakeClock` the generator *advances*
+the clock itself — ``step_cost_s`` per engine step, and straight to the
+next arrival when idle — so a whole offered-load drill replays
+bit-identically with zero sleeps (tests/test_slo.py pins this). With a
+real clock it sleeps instead, and the measured latencies are real.
+
+The report (:meth:`LoadGenerator.run`) carries the shared
+goodput-under-SLO accounting (:func:`~perceiver_io_tpu.observability.slo.offered_load`):
+offered = accepted + shed + rejected, so saturation shows up as goodput
+< 1, never as a shrunk denominator.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+ARRIVALS = ("poisson", "bursty", "ramp", "uniform")
+MODES = ("open", "closed")
+
+
+@dataclasses.dataclass
+class WorkloadSpec:
+    """Per-request shape distributions, all sampled from the generator's
+    injected rng. Ranges are inclusive ``(lo, hi)``."""
+
+    prompt_len: Tuple[int, int] = (4, 12)
+    max_new_tokens: Tuple[int, int] = (4, 8)
+    #: token-id draw range (lo inclusive, hi exclusive); keep below the
+    #: model's vocab and off the pad id
+    vocab: Tuple[int, int] = (1, 64)
+    #: closed-loop think time between a completion and the user's next
+    #: submission, seconds
+    think_time_s: Tuple[float, float] = (0.0, 0.0)
+
+    def sample_prompt(self, rng: np.random.Generator) -> np.ndarray:
+        lo, hi = self.prompt_len
+        n = int(rng.integers(lo, hi + 1))
+        return rng.integers(self.vocab[0], self.vocab[1], size=n, dtype=np.int32)
+
+    def sample_max_new(self, rng: np.random.Generator) -> int:
+        lo, hi = self.max_new_tokens
+        return int(rng.integers(lo, hi + 1))
+
+    def sample_think(self, rng: np.random.Generator) -> float:
+        lo, hi = self.think_time_s
+        return lo if hi <= lo else float(rng.uniform(lo, hi))
+
+
+class LoadGenerator:
+    """Drive an engine/fleet with a synthetic workload (module docstring).
+
+    :param engine: anything with the shared request surface — ``submit`` /
+        ``step`` / ``pending`` (both engines, the fleet router).
+    :param workload: the per-request shape distributions.
+    :param mode: ``"open"`` or ``"closed"``.
+    :param arrival: open-loop arrival process (:data:`ARRIVALS`).
+    :param rate_rps: open-loop offered rate (requests/second); for
+        ``ramp`` the starting rate.
+    :param ramp_to_rps: ``ramp``'s final rate, reached at the last arrival.
+    :param burst_size: ``bursty``'s requests per burst.
+    :param users: closed-loop concurrent synthetic users.
+    :param max_requests: total requests to offer, then drain and stop.
+    :param config: optional :class:`GenerationConfig` template; each
+        request gets ``dataclasses.replace(config,
+        max_new_tokens=sampled)``. None submits with the engine default
+        config (no per-request max_new variation).
+    :param deadline_s: per-request deadline forwarded to ``submit``.
+    :param rng: ``numpy`` Generator or int seed — the run's ONE source of
+        randomness.
+    :param clock: the engine's clock (share it!). A clock with
+        ``advance`` (FakeClock) is driven by the generator; a real clock
+        is slept against.
+    :param step_cost_s: simulated wall cost of one ``engine.step()`` under
+        a FakeClock (ignored for real clocks). This is what makes offered
+        rate meaningful in a frozen-clock drill — and the knob a test
+        turns up to inject a deterministic latency fault.
+    """
+
+    def __init__(self, engine, *, workload: Optional[WorkloadSpec] = None,
+                 mode: str = "open", arrival: str = "poisson",
+                 rate_rps: float = 10.0, ramp_to_rps: Optional[float] = None,
+                 burst_size: int = 4, users: int = 4, max_requests: int = 32,
+                 config=None, deadline_s: Optional[float] = None,
+                 rng=0, clock: Callable[[], float] = time.monotonic,
+                 step_cost_s: float = 0.001):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if arrival not in ARRIVALS:
+            raise ValueError(
+                f"arrival must be one of {ARRIVALS}, got {arrival!r}"
+            )
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        if max_requests < 1:
+            raise ValueError(f"max_requests must be >= 1, got {max_requests}")
+        if users < 1:
+            raise ValueError(f"users must be >= 1, got {users}")
+        if burst_size < 1:
+            raise ValueError(f"burst_size must be >= 1, got {burst_size}")
+        if arrival == "ramp" and (ramp_to_rps is None or ramp_to_rps <= 0):
+            raise ValueError(
+                f"arrival='ramp' needs ramp_to_rps > 0, got {ramp_to_rps}"
+            )
+        if step_cost_s <= 0:
+            # under a FakeClock the step cost is the only thing that moves
+            # time while the engine works; zero would spin the open loop
+            # forever inside one arrival gap
+            raise ValueError(f"step_cost_s must be > 0, got {step_cost_s}")
+        self.engine = engine
+        self.workload = workload if workload is not None else WorkloadSpec()
+        self.mode = mode
+        self.arrival = arrival
+        self.rate_rps = float(rate_rps)
+        self.ramp_to_rps = None if ramp_to_rps is None else float(ramp_to_rps)
+        self.burst_size = int(burst_size)
+        self.users = int(users)
+        self.max_requests = int(max_requests)
+        self.config = config
+        self.deadline_s = deadline_s
+        self.rng = rng if isinstance(rng, np.random.Generator) else np.random.default_rng(rng)
+        self._clock = clock
+        self.step_cost_s = float(step_cost_s)
+        self.handles: List[object] = []
+        self.offered = 0
+        self.shed = 0
+        self.rejected = 0
+
+    # -- time ----------------------------------------------------------------
+    def _tick(self) -> None:
+        """One engine step, charged ``step_cost_s`` on a FakeClock."""
+        self.engine.step()
+        advance = getattr(self._clock, "advance", None)
+        if advance is not None:
+            advance(self.step_cost_s)
+
+    def _wait_until(self, t: float) -> None:
+        """Idle until ``t``: jump a FakeClock straight there; nap a real
+        one (short naps — a real engine may retire work meanwhile)."""
+        advance = getattr(self._clock, "advance", None)
+        if advance is not None:
+            if t > self._clock():
+                advance(t - self._clock())
+        else:
+            now = self._clock()
+            if t > now:
+                time.sleep(min(t - now, 0.005))
+
+    # -- arrivals ------------------------------------------------------------
+    def _gaps(self) -> List[float]:
+        """The full open-loop inter-arrival schedule, drawn up front so the
+        offered pattern is independent of service times (the open-loop
+        contract)."""
+        n = self.max_requests
+        rng = self.rng
+        if self.arrival == "uniform":
+            return [1.0 / self.rate_rps] * n
+        if self.arrival == "poisson":
+            return [float(g) for g in rng.exponential(1.0 / self.rate_rps, size=n)]
+        if self.arrival == "bursty":
+            gaps = []
+            burst_gap = self.burst_size / self.rate_rps
+            for i in range(n):
+                if i % self.burst_size == 0:
+                    gaps.append(float(rng.exponential(burst_gap)))
+                else:
+                    gaps.append(0.0)
+            return gaps
+        # ramp: rate interpolates rate_rps -> ramp_to_rps across arrivals
+        gaps = []
+        for i in range(n):
+            frac = i / max(1, n - 1)
+            rate = self.rate_rps + frac * (self.ramp_to_rps - self.rate_rps)
+            gaps.append(float(rng.exponential(1.0 / rate)))
+        return gaps
+
+    # -- submission ----------------------------------------------------------
+    def _submit_one(self) -> Optional[object]:
+        from perceiver_io_tpu.reliability import QueueFull
+
+        prompt = self.workload.sample_prompt(self.rng)
+        cfg = self.config
+        if cfg is not None:
+            cfg = dataclasses.replace(
+                cfg, max_new_tokens=self.workload.sample_max_new(self.rng)
+            )
+        self.offered += 1
+        try:
+            handle = self.engine.submit(prompt, cfg, deadline_s=self.deadline_s)
+        except QueueFull:
+            self.shed += 1
+            return None
+        except ValueError:
+            self.rejected += 1
+            return None
+        self.handles.append(handle)
+        return handle
+
+    # -- the drills ----------------------------------------------------------
+    def _run_open(self) -> None:
+        gaps = self._gaps()
+        next_at = self._clock()
+        for gap in gaps:
+            next_at += gap
+            # serve residents while waiting out the arrival gap; an idle
+            # engine skips straight to the arrival (open loop never slows
+            # its offered schedule to match service rate)
+            while self._clock() < next_at:
+                if self.engine.pending():
+                    self._tick()
+                else:
+                    self._wait_until(next_at)
+            self._submit_one()
+        while self.engine.pending():
+            self._tick()
+
+    def _run_closed(self) -> None:
+        # per-user state: (handle or None, next submit time)
+        users: List[list] = [[None, self._clock()] for _ in range(self.users)]
+        while True:
+            now = self._clock()
+            for user in users:
+                handle, next_at = user
+                if handle is not None and handle.done:
+                    user[0] = None
+                    user[1] = now + self.workload.sample_think(self.rng)
+                    handle, next_at = user
+                if handle is None and self.offered < self.max_requests and now >= next_at:
+                    user[0] = self._submit_one()
+            if self.offered >= self.max_requests and not self.engine.pending():
+                if all(u[0] is None or u[0].done for u in users):
+                    return
+            if self.engine.pending():
+                self._tick()
+            else:
+                soonest = min(
+                    (u[1] for u in users if u[0] is None), default=None
+                )
+                if soonest is None or self.offered >= self.max_requests:
+                    return
+                self._wait_until(max(soonest, now))
+
+    def run(self) -> dict:
+        """Offer the whole workload, drain, and return the report:
+        generator-side offered/shed/rejected accounting, terminal
+        disposition counts from the request handles, wall span on the
+        run's clock, and the achieved rates. ``handles`` stays on the
+        instance for per-request inspection."""
+        t0 = self._clock()
+        if self.mode == "open":
+            self._run_open()
+        else:
+            self._run_closed()
+        span_s = max(self._clock() - t0, 1e-9)
+        by_status: dict = {}
+        for h in self.handles:
+            by_status[h.status] = by_status.get(h.status, 0) + 1
+        completed = by_status.get("ok", 0)
+        return {
+            "mode": self.mode,
+            "arrival": self.arrival if self.mode == "open" else None,
+            "offered": self.offered,
+            "accepted": len(self.handles),
+            "shed": self.shed,
+            "rejected": self.rejected,
+            "completed": completed,
+            "timed_out": by_status.get("timed_out", 0),
+            "failed": by_status.get("failed", 0),
+            "by_status": dict(sorted(by_status.items())),
+            "span_s": round(span_s, 6),
+            "offered_rps": round(self.offered / span_s, 4),
+            "completed_rps": round(completed / span_s, 4),
+            # the shared goodput definition: completed / offered
+            # (observability/slo.py — shed and rejected stay in the
+            # denominator)
+            "goodput_ratio": round(completed / max(1, self.offered), 4),
+        }

@@ -138,6 +138,103 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
         "padding": padding,
         "fleet": _fleet_section(events, snapshot),
         "kv_pool": _kv_pool_section(snapshot),
+        "slo": _slo_section(events, snapshot),
+    }
+
+
+def _slo_section(events: List[dict], snapshot: dict) -> Optional[dict]:
+    """SLO telemetry rollup (docs/observability.md): TTFT / inter-token
+    latency tables, the breach timeline, burn-rate gauges, and the shared
+    goodput-under-SLO accounting. Latency percentiles come straight from
+    the snapshot's registry histogram summaries — the registry's own
+    nearest-rank values, reproduced exactly — with a fallback recomputation
+    from ``serving.first_token`` events through the SAME
+    :class:`~perceiver_io_tpu.observability.Histogram` when only events
+    exist. None when the run recorded nothing SLO-shaped (old artifacts
+    stay unchanged)."""
+    from perceiver_io_tpu.observability.slo import goodput_ratio, offered_load
+
+    hists = snapshot.get("histograms") or {}
+    counters = snapshot.get("counters") or {}
+    gauges = snapshot.get("gauges") or {}
+    first_tokens = [r for r in events if r.get("span") == "serving.first_token"]
+    transitions = [
+        r for r in events if r.get("span") in ("slo.breach", "slo.recover")
+    ]
+    has_slo = (
+        "serving_ttft_ms" in hists or "serving_inter_token_ms" in hists
+        or first_tokens or transitions
+        or any(k.startswith("slo_") for k in counters)
+    )
+    if not has_slo:
+        return None
+
+    def latency(hist_name: str, event_attr: str) -> Optional[dict]:
+        summ = hists.get(hist_name)
+        if summ is not None:
+            return {
+                "source": "snapshot", "count": summ.get("count"),
+                "p50_ms": summ.get("p50"), "p95_ms": summ.get("p95"),
+                "p99_ms": summ.get("p99"), "max_ms": summ.get("max"),
+            }
+        vals = [
+            float((r.get("attrs") or {})[event_attr]) for r in first_tokens
+            if isinstance((r.get("attrs") or {}).get(event_attr), (int, float))
+        ]
+        if not vals:
+            return None
+        hist = Histogram()
+        for v in vals:
+            hist.observe(v)
+        summ = hist.summary()
+        return {
+            "source": "events", "count": summ["count"],
+            "p50_ms": summ["p50"], "p95_ms": summ["p95"],
+            "p99_ms": summ["p99"], "max_ms": summ["max"],
+        }
+
+    t0 = min(
+        (r["start_s"] for r in events
+         if isinstance(r.get("start_s"), (int, float))),
+        default=0.0,
+    )
+    timeline = [
+        {
+            "offset_s": round(float(r.get("start_s") or t0) - t0, 6),
+            "event": r.get("span"),
+            "dimension": (r.get("attrs") or {}).get("dimension"),
+            "burn_fast": (r.get("attrs") or {}).get("burn_fast"),
+            "burn_slow": (r.get("attrs") or {}).get("burn_slow"),
+        }
+        for r in sorted(transitions, key=lambda r: r.get("start_s") or 0.0)
+    ]
+
+    def c(name: str) -> Optional[int]:
+        v = counters.get(name)
+        return None if v is None else int(v)
+
+    goodput = None
+    prefix = "fleet" if any(
+        k.startswith("fleet_requests_") for k in counters
+    ) else "serving"
+    if counters:
+        goodput = {
+            "prefix": prefix,
+            "offered": offered_load(counters, prefix),
+            "completed": c(f"{prefix}_requests_completed_total"),
+            "ratio": round(goodput_ratio(counters, prefix), 4),
+        }
+    return {
+        "ttft": latency("serving_ttft_ms", "ttft_ms"),
+        "inter_token": latency("serving_inter_token_ms", "inter_token_ms"),
+        "first_token_events": len(first_tokens),
+        "breaches": c("slo_breach_total"),
+        "recoveries": c("slo_recoveries_total"),
+        "burn_rates": {
+            k: gauges[k] for k in sorted(gauges) if k.startswith("slo_burn_rate")
+        },
+        "timeline": timeline,
+        "goodput": goodput,
     }
 
 
@@ -449,6 +546,50 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"breaker_opens={fleet['breaker_opens']}  "
                 f"replica_restarts={fleet['replica_restarts']}  "
                 f"duplicates_ignored={fleet['duplicates_ignored']}"
+            )
+
+    slo = analysis.get("slo")
+    if slo:
+        out.append("")
+        out.append("== slo ==")
+        out.append(
+            f"{'metric':<18}{'count':>8}{'p50_ms':>10}{'p95_ms':>10}"
+            f"{'p99_ms':>10}{'max_ms':>10}  source"
+        )
+        for label, key in (("ttft", "ttft"), ("inter_token", "inter_token")):
+            row = slo.get(key)
+            if row:
+                out.append(
+                    f"{label:<18}{_fmt(row['count'], 8)}{_fmt(row['p50_ms'])}"
+                    f"{_fmt(row['p95_ms'])}{_fmt(row['p99_ms'])}"
+                    f"{_fmt(row['max_ms'])}  {row['source']}"
+                )
+            else:
+                out.append(f"{label:<18}{'-':>8}  (no samples)")
+        if slo["breaches"] is not None:
+            out.append(
+                f"breaches={slo['breaches']}  recoveries={slo['recoveries']}"
+            )
+        if slo["burn_rates"]:
+            out.append(
+                "burn rates: "
+                + ", ".join(f"{k}={v}" for k, v in slo["burn_rates"].items())
+            )
+        if slo["timeline"]:
+            out.append("breach timeline:")
+            for row in slo["timeline"]:
+                out.append(
+                    f"  +{row['offset_s']:>10.3f} s  {row['event']:<14}"
+                    f" dim={row['dimension']}"
+                    f" burn_fast={row['burn_fast']}"
+                    + (f" burn_slow={row['burn_slow']}"
+                       if row["burn_slow"] is not None else "")
+                )
+        if slo["goodput"]:
+            g = slo["goodput"]
+            out.append(
+                f"goodput ({g['prefix']}): {g['completed']}/{g['offered']} "
+                f"offered = {g['ratio']}"
             )
 
     kv = analysis.get("kv_pool")

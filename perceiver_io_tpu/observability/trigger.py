@@ -103,6 +103,17 @@ class ProfilerTrigger:
         """Whether the next step should be captured."""
         return self._armed
 
+    def arm(self) -> bool:
+        """Arm a capture of the next step directly — the external-signal
+        path (an :class:`~perceiver_io_tpu.observability.slo.SLOMonitor`
+        breach arms a capture even when the regression lives in queueing,
+        not step time). Respects the capture budget and cooldown exactly
+        like :meth:`observe`; returns whether the trigger is now armed."""
+        if self.captures >= self.max_captures or self._cooldown_left > 0:
+            return self._armed
+        self._armed = True
+        return True
+
     @contextlib.contextmanager
     def capture(self, *, step: Optional[int] = None):
         """Run the enclosed (regressed) step under a profiler capture and

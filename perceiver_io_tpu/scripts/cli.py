@@ -348,6 +348,23 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
                 "process_metrics": default_registry().snapshot(),
             },
         )
+    slo_monitor = None
+    if obs.slo.enabled and is_main:
+        from perceiver_io_tpu.observability import SLOMonitor
+
+        # SLO targets (docs/observability.md): burn-rate gauges/counters on
+        # the kit registry (single-engine serving shares it; a fleet keeps
+        # its fleet_* families there too), breach events on the kit tracer
+        # when events are on, and breach -> profiler-trigger arming when a
+        # trigger exists. run_serve wires the latency/disposition feeds.
+        slo_monitor = SLOMonitor(
+            obs.slo.policy(),
+            registry=registry,
+            tracer=None,  # run_serve swaps in its tracer (always built there)
+            fast_window_s=obs.slo.fast_window_s,
+            slow_window_s=obs.slo.slow_window_s,
+            breach_burn_rate=obs.slo.burn_rate,
+        )
     trigger = None
     if obs.profile_on_regress_factor is not None and is_main:
         if jax.process_count() > 1:
@@ -364,12 +381,15 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
                 os.path.join(root, "profile_regress"),
                 factor=obs.profile_on_regress_factor,
             )
+    if slo_monitor is not None:
+        slo_monitor.profiler_trigger = trigger
     return {
         "registry": registry,
         "tracer": tracer,
         "sink": sink,
         "snapshot_writer": snapshot_writer,
         "trigger": trigger,
+        "slo_monitor": slo_monitor,
     }
 
 
@@ -563,6 +583,14 @@ class CLI:
         from perceiver_io_tpu.training.optim import make_optimizer
         from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
 
+        if any(k.startswith("obs.slo.") for k in values):
+            # inapplicable-flag convention: SLO targets judge SERVING token
+            # latency; a fit run has no TTFT to monitor. Checked before any
+            # datamodule/model work so the error is instant.
+            raise SystemExit(
+                "--obs.slo.* applies to the serve subcommand (SLO targets "
+                "monitor serving token latency; docs/observability.md)"
+            )
         data_kwargs = {
             k.split(".", 1)[1]: v for k, v in values.items() if k.startswith("data.")
         }
@@ -721,6 +749,10 @@ class CLI:
         # so the engine always gets a tracer — sink-less when --obs.events_path
         # is unset (spans stay in the bounded in-memory buffer).
         tracer = kit["tracer"] or Tracer()
+        if kit["slo_monitor"] is not None:
+            # slo.breach / slo.recover events land on the run's tracer
+            # (into events.jsonl when configured — the obs-report timeline)
+            kit["slo_monitor"].tracer = tracer
         # the device-cost ledger's builds stream into events.jsonl as
         # `ledger.compile` events, so an offline `obs report` over the
         # events alone still carries the compile/memory table
@@ -879,9 +911,22 @@ class CLI:
                     step_timeout_s=args.step_timeout_s,
                     registry=kit["registry"],
                     tracer=tracer,
+                    # telemetry-driven admission (docs/observability.md): a
+                    # sustained burn tightens max_pending/deadline shedding
+                    slo_monitor=kit["slo_monitor"],
+                    slo_shed_factor=obs.slo.shed_factor,
                 )
             else:
                 engine = make_engine()
+                if kit["slo_monitor"] is not None:
+                    # single-engine SLO feeds: the engine mirrors every
+                    # TTFT/ITL sample into the monitor, and the error-rate
+                    # dimension diffs the serving_* disposition counters
+                    # (same registry) per poll
+                    engine.latency_sink = kit["slo_monitor"].sink
+                    kit["slo_monitor"].watch_counters(
+                        kit["registry"].counters, prefix="serving"
+                    )
             if args.warmup:
                 t0 = time.monotonic()
                 compiles = engine.warmup()
@@ -959,14 +1004,26 @@ class CLI:
         # wall time — generates, or a mid-run poller sees stale telemetry.
         # pending(), not step()'s return value: a slot-engine step advances
         # one token and legitimately disposes of nothing mid-generation.
+        # The SLO monitor is polled per pass for the single-engine path
+        # (the fleet router polls it inside its own step()).
+        slo_monitor = kit["slo_monitor"]
+        fleet_polls = hasattr(engine, "slo_monitor")
         while engine.pending():
             if (
                 engine.step() == 0
                 and not getattr(engine, "last_step_made_progress", True)
             ):
                 time.sleep(0.005)  # fleet waiting out a breaker cooldown
+            if slo_monitor is not None and not fleet_polls:
+                slo_monitor.poll()
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write()
+        if slo_monitor is not None:
+            # unconditional final poll: the fleet router polls at the START
+            # of each step, so the last step's dispositions would otherwise
+            # never be diffed into the error window (a duplicate poll is an
+            # idempotent counter diff — harmless for the single-engine path)
+            slo_monitor.poll()
         engine.drain()  # queue already empty: just stop accepting
         wall_s = time.monotonic() - t0
 
@@ -1001,6 +1058,11 @@ class CLI:
             # families that live beside, not on, the engine's registry)
             stats["compile_ledger"] = default_ledger().snapshot()
             stats["process_metrics"] = default_registry().snapshot()
+            if kit["slo_monitor"] is not None and "slo" not in stats:
+                # fleet stats() already embeds the monitor; single-engine
+                # runs attach it here so serve_stats always carries the
+                # burn/breach summary when SLO targets were set
+                stats["slo"] = kit["slo_monitor"].stats()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return results
 
@@ -1019,6 +1081,10 @@ class CLI:
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "
               "--obs.snapshot_path --obs.profile_on_regress_factor "
               "(fit and serve; docs/observability.md)")
+        print("slo (serve): --obs.slo.ttft_p95_ms --obs.slo.inter_token_p95_ms "
+              "--obs.slo.error_rate --obs.slo.fast_window_s --obs.slo.slow_window_s "
+              "--obs.slo.burn_rate --obs.slo.shed_factor — burn-rate monitor, "
+              "breach events, fleet admission tightening")
         print("obs report: --events=<events.jsonl> [--snapshot=<snapshot.json>] "
               "[--top N] [--json true] — offline latency/compile/padding report")
         print(f"data modules: {sorted(self.family.data_registry)}")

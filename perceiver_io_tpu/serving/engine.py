@@ -158,6 +158,17 @@ class ServeRequest:
     #: per-request trace ID (None when the engine has no tracer) — the join
     #: key between the serve CLI's JSON lines and events.jsonl
     trace_id: Optional[str] = None
+    #: TTFT measurement anchor on the engine clock — defaults to
+    #: ``submitted_at``. The fleet router backdates it to the FLEET submit
+    #: time at dispatch, so time-to-first-token stays the user-facing
+    #: number (front door → first token) instead of resetting at each
+    #: replica handoff. Queue-wait / request-latency accounting keeps
+    #: using ``submitted_at`` — those attribute THIS engine's share.
+    ttft_anchor_s: Optional[float] = None
+
+    @property
+    def ttft_from_s(self) -> float:
+        return self.submitted_at if self.ttft_anchor_s is None else self.ttft_anchor_s
 
     @property
     def done(self) -> bool:
@@ -259,6 +270,23 @@ class ServingEngine:
         )
         self.tracer = tracer
         self.profiler_trigger = profiler_trigger
+        #: optional mirror for the per-token latency histograms
+        #: (``serving_ttft_ms`` / ``serving_inter_token_ms``,
+        #: docs/observability.md): called with ``(name, value_ms)`` after
+        #: the engine's own registry observes. The fleet router installs
+        #: one per replica so fleet-scope percentiles exist beside the
+        #: per-replica ones, and an
+        #: :class:`~perceiver_io_tpu.observability.slo.SLOMonitor`'s
+        #: ``sink`` plugs in the same way.
+        self.latency_sink: Optional[Callable[[str, float], None]] = None
+
+    def _observe_token_latency(self, name: str, value_ms: float) -> None:
+        """One TTFT / inter-token observation: engine registry first (the
+        scope ``stats()`` reads), then the optional mirror (replica → fleet
+        scope, SLO monitor)."""
+        self.registry.observe(name, value_ms)
+        if self.latency_sink is not None:
+            self.latency_sink(name, value_ms)
 
     def _device_capture(self, *, step=None):
         """Context for one device dispatch: a profiler capture when the
@@ -279,13 +307,17 @@ class ServingEngine:
 
     # -- queue front --------------------------------------------------------
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
-               *, deadline_s: Optional[float] = None) -> ServeRequest:
+               *, deadline_s: Optional[float] = None,
+               ttft_anchor_s: Optional[float] = None) -> ServeRequest:
         """Enqueue one prompt (1-D token ids); returns its request handle.
 
         Raises ``ValueError`` for infeasible prompts (empty, or longer than
         the largest bucket / prefix capacity) at submit time — never inside
         bucket packing — and :class:`QueueFull` when the bounded queue is at
         ``max_queue`` (the request is shed and counted, not enqueued).
+        ``ttft_anchor_s`` backdates the TTFT measurement to an earlier
+        instant on the same clock (the fleet router passes its front-door
+        submit time — see :class:`ServeRequest`).
         """
         if not self._accepting:
             raise RuntimeError("engine is draining; new submissions rejected")
@@ -317,6 +349,7 @@ class ServingEngine:
             self._next_id, prompt, cfg, now,
             deadline_at=None if deadline_s is None else now + deadline_s,
             trace_id=self.tracer.new_trace_id() if self.tracer else None,
+            ttft_anchor_s=ttft_anchor_s,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -594,8 +627,26 @@ class ServingEngine:
             self.profiler_trigger.observe(execute_ms)
         if batch_span is not None:
             self.tracer.end_span(batch_span, execute_ms=round(execute_ms, 3))
+        # Per-request token-latency accounting (docs/observability.md): the
+        # bucket engine is batch-granular — every token of the micro-batch
+        # materializes at the np.asarray fence above — so TTFT is submit →
+        # batch completion and inter-token latency is the amortized device
+        # time per generated token, ONE sample per request (a per-token
+        # observation would just repeat the same amortized value). The slot
+        # engine records both per real token step.
+        done_at = self._clock()
+        itl_ms = execute_ms / max(1, cfg.max_new_tokens)
         for i, req in enumerate(picked):
             req.result = out[i]
+            ttft_ms = (done_at - req.ttft_from_s) * 1e3
+            self._observe_token_latency("serving_ttft_ms", ttft_ms)
+            self._observe_token_latency("serving_inter_token_ms", itl_ms)
+            if self.tracer is not None:
+                self.tracer.event(
+                    "serving.first_token", trace_id=req.trace_id,
+                    ttft_ms=round(ttft_ms, 3),
+                    inter_token_ms=round(itl_ms, 3), batch_granular=True,
+                )
             self._finish(req, "ok")
         self.registry.inc(
             "serving_tokens_generated_total", len(picked) * cfg.max_new_tokens
@@ -698,6 +749,17 @@ class ServingEngine:
             "queue_wait_ms": {
                 "p50": _round_ms(reg.percentile("serving_queue_wait_ms", 50.0)),
                 "p95": _round_ms(reg.percentile("serving_queue_wait_ms", 95.0)),
+            },
+            # the SLO-facing token latencies (docs/observability.md): TTFT
+            # and inter-token latency, per-token on the slot engine,
+            # batch-amortized on this one
+            "ttft_ms": {
+                "p50": _round_ms(reg.percentile("serving_ttft_ms", 50.0)),
+                "p95": _round_ms(reg.percentile("serving_ttft_ms", 95.0)),
+            },
+            "inter_token_ms": {
+                "p50": _round_ms(reg.percentile("serving_inter_token_ms", 50.0)),
+                "p95": _round_ms(reg.percentile("serving_inter_token_ms", 95.0)),
             },
             "prompt_padding_efficiency": round(real / max(1, padded), 4),
             "bucket_grid": {

@@ -101,6 +101,7 @@ FLEET_COUNTERS = (
     "fleet_replica_failures_total",
     "fleet_replica_restarts_total",
     "fleet_duplicate_results_total",
+    "fleet_slo_shed_total",
 )
 
 
@@ -204,13 +205,22 @@ class Replica:
 
     def __init__(self, factory: Callable[[], object], replica_id: int, *,
                  clock: Callable[[], float] = time.monotonic, chaos=None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 latency_mirror: Optional[Callable[[str, float], None]] = None):
         self.factory = factory
         self.replica_id = int(replica_id)
         self._clock = clock
         self._chaos = chaos
+        #: installed as the engine's ``latency_sink`` (rebuilds included):
+        #: every per-token TTFT / inter-token observation the replica's
+        #: engine records on its PRIVATE registry is mirrored here too, so
+        #: the router gets fleet-scope ``serving_ttft_ms`` /
+        #: ``serving_inter_token_ms`` percentiles (and the SLO monitor its
+        #: samples) without collapsing the per-replica attribution
+        self.latency_mirror = latency_mirror
         self.breaker = breaker if breaker is not None else CircuitBreaker(clock=clock)
         self.engine = factory()
+        self._install_latency_mirror()
         #: fleet request id -> engine ServeRequest handle. Entries persist
         #: across a HUNG failover (the slow copy may still complete — the
         #: dedupe path) and are cleared by :meth:`restart` (a crashed
@@ -245,12 +255,17 @@ class Replica:
         self.last_step_wall_s = self._clock() - t0
         return disposed
 
+    def _install_latency_mirror(self) -> None:
+        if self.latency_mirror is not None and hasattr(self.engine, "latency_sink"):
+            self.engine.latency_sink = self.latency_mirror
+
     def restart(self) -> None:
         """Rebuild the engine from the factory — the crashed-process model:
         queued and resident engine work is lost (the router already failed
         it over), the executor caches are process-global so the fresh
         engine compiles nothing new."""
         self.engine = self.factory()
+        self._install_latency_mirror()
         self.handles.clear()
         self.restarts += 1
 
@@ -314,6 +329,20 @@ class FleetRouter:
         default retries 3 times immediately; set ``jitter`` + the policy's
         base to spread a redispatch storm (``redispatch_seed`` feeds the
         deterministic rng).
+    :param slo_monitor: optional
+        :class:`~perceiver_io_tpu.observability.slo.SLOMonitor` — the
+        telemetry-driven admission loop (docs/observability.md). The
+        router feeds it: every replica's per-token TTFT / inter-token
+        observations (via the latency mirror) and the fleet's terminal
+        dispositions (via ``watch_counters`` over the fleet registry), and
+        polls it once per :meth:`step`. While the monitor reports a
+        breach, admission TIGHTENS deterministically: the effective
+        ``max_pending`` and default deadline scale by ``slo_shed_factor``,
+        so sustained burn sheds load at the front door instead of letting
+        the queue push latency further past target. Extra sheds caused by
+        the tightened bound are counted ``fleet_slo_shed_total`` (they
+        also count in the ordinary shed counter).
+    :param slo_shed_factor: the tightening multiplier in ``(0, 1]``.
     """
 
     def __init__(self, engine_factories: Sequence[Callable[[], object]], *,
@@ -328,7 +357,9 @@ class FleetRouter:
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 30.0,
                  redispatch_policy: Optional[RetryPolicy] = None,
-                 redispatch_seed: int = 0):
+                 redispatch_seed: int = 0,
+                 slo_monitor=None,
+                 slo_shed_factor: float = 0.5):
         factories = list(engine_factories)
         if not factories:
             raise ValueError("a fleet needs at least one engine factory")
@@ -348,6 +379,12 @@ class FleetRouter:
             redispatch_policy if redispatch_policy is not None
             else RetryPolicy(max_retries=3, backoff_base_s=0.0)
         )
+        if not 0.0 < slo_shed_factor <= 1.0:
+            raise ValueError(
+                f"slo_shed_factor must be in (0, 1], got {slo_shed_factor}"
+            )
+        self.slo_monitor = slo_monitor
+        self.slo_shed_factor = float(slo_shed_factor)
         self._rng = random.Random(redispatch_seed)
         self._replicas = [
             Replica(
@@ -356,9 +393,15 @@ class FleetRouter:
                     failure_threshold=breaker_threshold,
                     cooldown_s=breaker_cooldown_s, clock=clock,
                 ),
+                latency_mirror=self._mirror_token_latency,
             )
             for i, f in enumerate(factories)
         ]
+        if slo_monitor is not None:
+            # error-rate dimension: fed from the fleet's own disposition
+            # counters, diffed per poll — the router never sees engine
+            # tokens, but it IS the one source of terminal fleet states
+            slo_monitor.watch_counters(self.registry.counters, prefix="fleet")
         self._queue: List[FleetRequest] = []
         self._dispatched: Dict[int, FleetRequest] = {}
         #: every non-terminal request (queued OR dispatched), by id — the
@@ -387,6 +430,28 @@ class FleetRouter:
         hot-spinning on breaker cooldowns (the serve CLI does)."""
         return self._last_step_activity
 
+    def _mirror_token_latency(self, name: str, value_ms: float) -> None:
+        """Every replica engine's ``latency_sink``: fleet-scope TTFT / ITL
+        histograms on the router registry, plus the SLO monitor's latency
+        dimensions (docs/observability.md — engine, replica, and fleet
+        scope are three registries observing the same samples)."""
+        self.registry.observe(name, value_ms)
+        if self.slo_monitor is not None:
+            self.slo_monitor.sink(name, value_ms)
+
+    def _effective_admission(self) -> Tuple[Optional[int], Optional[float]]:
+        """``(max_pending, default_deadline_s)`` as currently enforced:
+        the configured bounds, scaled by ``slo_shed_factor`` while the SLO
+        monitor reports a breach — telemetry-driven shedding, deterministic
+        because the monitor's windows run on the injectable clock."""
+        limit, deadline = self.max_pending, self.default_deadline_s
+        if self.slo_monitor is not None and self.slo_monitor.breached:
+            if limit is not None:
+                limit = max(1, int(limit * self.slo_shed_factor))
+            if deadline is not None:
+                deadline = deadline * self.slo_shed_factor
+        return limit, deadline
+
     # -- queue front --------------------------------------------------------
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
                *, deadline_s: Optional[float] = None) -> FleetRequest:
@@ -397,6 +462,9 @@ class FleetRouter:
         ``check_feasible``, so slot-engine scope limits apply fleet-wide),
         :class:`QueueFull` past ``max_pending`` — both carry a
         ``trace_id`` and a terminal span, like the engines' rejections.
+        While the SLO monitor reports a sustained burn, the effective
+        ``max_pending`` and default deadline are tightened by
+        ``slo_shed_factor`` (:meth:`_effective_admission`).
         """
         if not self._accepting:
             raise RuntimeError("fleet is draining; new submissions rejected")
@@ -407,18 +475,33 @@ class FleetRouter:
             self.registry.inc("fleet_requests_rejected_total")
             e.trace_id = self._terminal_event("rejected", error=str(e))
             raise
+        max_pending, default_deadline_s = self._effective_admission()
         in_flight = len(self._queue) + len(self._dispatched)
-        if self.max_pending is not None and in_flight >= self.max_pending:
+        if max_pending is not None and in_flight >= max_pending:
+            # a shed is attributed to the SLO tightening only when the
+            # CONFIGURED bound would have admitted it — genuine overload
+            # sheds during a breach stay ordinary sheds (and keep feeding
+            # the monitor's error dimension, which excludes slo_shed)
+            tightened = (
+                max_pending != self.max_pending
+                and in_flight < self.max_pending
+            )
             self.registry.inc("fleet_requests_shed_total")
+            if tightened:
+                self.registry.inc("fleet_slo_shed_total")
             exc = QueueFull(
                 f"fleet has {in_flight} requests in flight, at max_pending="
-                f"{self.max_pending}; request shed — drain with step() or "
-                "retry after backoff"
+                f"{max_pending}"
+                + (f" (tightened from {self.max_pending} by SLO burn)"
+                   if tightened else "")
+                + "; request shed — drain with step() or retry after backoff"
             )
-            exc.trace_id = self._terminal_event("shed", in_flight=in_flight)
+            exc.trace_id = self._terminal_event(
+                "shed", in_flight=in_flight, slo_tightened=tightened,
+            )
             raise exc
         if deadline_s is None:
-            deadline_s = self.default_deadline_s
+            deadline_s = default_deadline_s
         now = self._clock()
         req = FleetRequest(
             self._next_id, prompt, config, now,
@@ -724,8 +807,12 @@ class FleetRouter:
                     disposed += 1
                     continue
             try:
+                # ttft_anchor_s: TTFT is user-facing — measured from the
+                # FLEET front door, so fleet queue wait (and failover
+                # replays) stay inside the number the SLO judges
                 handle = replica.engine.submit(
-                    req.prompt, req.config, deadline_s=remaining
+                    req.prompt, req.config, deadline_s=remaining,
+                    ttft_anchor_s=req.submitted_at,
                 )
             except QueueFull:
                 self._queue.append(req)  # engine backpressure: wait, not a fault
@@ -893,6 +980,11 @@ class FleetRouter:
         number of fleet requests terminally disposed of; drive drain loops
         off :meth:`pending` (a mid-generation pass legitimately disposes of
         nothing)."""
+        if self.slo_monitor is not None:
+            # one burn evaluation per scheduling pass: breach/recovery
+            # transitions (and the admission tightening they gate) happen
+            # here, on the shared clock, never mid-submit
+            self.slo_monitor.poll()
         disposed = self._expire_overdue()
         disposed += self._dispatch_pending()
         stepped_any = False
@@ -1018,6 +1110,18 @@ class FleetRouter:
                 "p50": reg.percentile("fleet_request_latency_ms", 50.0),
                 "p95": reg.percentile("fleet_request_latency_ms", 95.0),
             },
+            # fleet-scope token latencies, mirrored from every replica's
+            # engine (docs/observability.md)
+            "ttft_ms": {
+                "p50": reg.percentile("serving_ttft_ms", 50.0),
+                "p95": reg.percentile("serving_ttft_ms", 95.0),
+            },
+            "inter_token_ms": {
+                "p50": reg.percentile("serving_inter_token_ms", 50.0),
+                "p95": reg.percentile("serving_inter_token_ms", 95.0),
+            },
+            "slo": None if self.slo_monitor is None else self.slo_monitor.stats(),
+            "slo_sheds": c("fleet_slo_shed_total"),
             "per_replica": [
                 {
                     "replica_id": r.replica_id,
@@ -1040,9 +1144,13 @@ class FleetRouter:
         depth = len(self._queue) + len(self._dispatched)
         reg = self.registry
         healthy = sum(1 for r in self._replicas if r.breaker.state == "closed")
+        # admission as currently ENFORCED — under SLO tightening, "ready"
+        # flips false at the reduced bound, so a well-behaved front end
+        # backs off before tripping the shed counter
+        max_pending, _ = self._effective_admission()
         return {
             "ready": self._accepting and healthy > 0
-            and (self.max_pending is None or depth < self.max_pending),
+            and (max_pending is None or depth < max_pending),
             "accepting": self._accepting,
             "queue_depth": depth,
             "max_queue": self.max_pending,

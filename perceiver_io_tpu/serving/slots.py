@@ -513,6 +513,9 @@ class _Slot:
     max_new: int
     m: int  # mirrors state["m"][slot] for decode-variant choice
     emitted: List[int] = dataclasses.field(default_factory=list)
+    #: engine-clock time this row's latest token materialized — the
+    #: inter-token latency anchor (docs/observability.md)
+    last_token_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -1336,10 +1339,33 @@ class SlotServingEngine(ServingEngine):
         self.registry.inc("serving_decode_rows_padded_total", self.slots - len(active))
         self.registry.inc("serving_tokens_generated_total", len(active))
         eos = self.config.eos_token_id
+        # Per-request token-latency accounting (docs/observability.md): the
+        # np.asarray fence above materialized every slot's token, so all
+        # active rows share this step's completion instant — TTFT for rows
+        # that just emitted their first token (submit → that instant, queue
+        # wait and prefill included), inter-token latency for the rest
+        # (previous token's instant → this one, so a long admission or a
+        # boundary-variant step shows up in every RESIDENT row's ITL).
+        token_at = self._clock()
         for entry in active:
             token = int(tokens[entry.slot])
+            first = not entry.emitted
             entry.emitted.append(token)
             entry.m = min(entry.m + 1, self.model.max_latents)
+            if first:
+                ttft_ms = (token_at - entry.req.ttft_from_s) * 1e3
+                self._observe_token_latency("serving_ttft_ms", ttft_ms)
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "serving.first_token", trace_id=entry.req.trace_id,
+                        slot=entry.slot, ttft_ms=round(ttft_ms, 3),
+                    )
+            else:
+                self._observe_token_latency(
+                    "serving_inter_token_ms",
+                    (token_at - entry.last_token_at) * 1e3,
+                )
+            entry.last_token_at = token_at
             if (eos is not None and token == eos) or len(entry.emitted) >= entry.max_new:
                 self._retire(entry, "ok")
                 disposed += 1

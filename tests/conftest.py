@@ -63,6 +63,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "slo: SLO telemetry test (per-token latency accounting, burn-rate "
+        "monitor, load generator, telemetry-driven fleet admission; "
+        "observability/slo.py, observability/loadgen.py; "
+        "docs/observability.md); CPU-fast, runs in the tier-1 suite with a "
+        "tight per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )
