@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos obs obs-report slo slo-bench decode-strategy decode-tune cov bench serve-bench paged-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos obs obs-report slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -51,6 +51,28 @@ slo-bench:
 	model = CausalLanguageModel(cfg); \
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'slo_goodput': bench._bench_slo_goodput(model, params, cfg)}, indent=2))"
+
+# HTTP/SSE streaming-gateway suite (docs/serving.md "Streaming"): token
+# streaming over real sockets, client-disconnect cancellation, zero
+# slot/page leak, socket-anchored TTFT — CPU-fast, also tier-1
+gateway:
+	$(PY) -m pytest tests/ -q -m gateway --continue-on-collection-errors
+
+# mid-stream mass-abandonment drill at the CPU-fallback shape
+# (docs/serving.md "Streaming"): scripted client abandonment against the
+# paged slot engine under FakeClock — cancelled-slot reclaim latency,
+# pool-page zero-leak, survivor token-identity
+stream-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'streaming': bench._bench_streaming(model, params, cfg)}, indent=2))"
 
 # decode-strategy suite (per-phase cached-vs-recompute + chunked prefill;
 # docs/serving.md, docs/benchmarks.md) — CPU-fast, also tier-1

@@ -615,6 +615,22 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "slo_goodput": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: streaming abandonment drill (gateway cancellation path) ----
+        if left() > 90.0:
+            log("run: streaming probe (mid-stream mass abandonment, zero-leak)")
+            try:
+                stm = _bench_streaming(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "streaming": stm})
+                log(f"run: streaming abandoned {stm['abandoned']}/{stm['requests']} "
+                    f"mid-stream — survivors token_identical="
+                    f"{stm['token_identical']}, pool leak {stm['pool']['leaked']} "
+                    f"blocks, reclaim p95 {stm['reclaim']['p95_ms']} ms "
+                    f"(accounting_closed={stm['accounting_closed']})")
+            except Exception as e:
+                log(f"run: streaming probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "streaming": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # BENCH_* records carry the process-wide telemetry snapshot AND the
         # device-cost ledger (per-executor compile/memory/retrace table;
         # docs/observability.md) — every BENCH_* file is `obs report`-able.
@@ -1570,9 +1586,155 @@ def _bench_observability(model, params, cfg, *, n_requests: int = 12,
     }
 
 
+def _bench_streaming(model, params, cfg, *, slots: int = 4, n_requests: int = 10,
+                     abandon_every: int = 2, cancel_after_tokens: int = 2,
+                     new_tokens: int = 6):
+    """Mid-stream mass-abandonment drill (docs/serving.md "Streaming"):
+    the gateway's cancellation-safe retirement path, driven deterministically
+    under :class:`~perceiver_io_tpu.reliability.FakeClock` — no sockets, so
+    the drill replays bit-identically and the numbers are scheduling, not
+    network, latency.
+
+    ``n_requests`` streamed requests run through a PAGED slot engine with
+    per-request ``on_token`` sinks; every ``abandon_every``-th stream is
+    abandoned the scheduler pass after its ``cancel_after_tokens``-th token
+    materializes (how a gateway notices a disconnect: between steps). The
+    record pins the three acceptance invariants:
+
+    - **reclaim latency** — token-instant → pool-pages-freed, per victim
+      (bounded by one scheduler pass; the "within one step()" bar);
+    - **zero leak** — ``kv_pool`` blocks in use / reserved / leaked all 0
+      at drain, with the cancelled frees separable in ``frees_by_cause``;
+    - **survivor token-identity** — unaffected streams' outputs match a
+      fault-free engine pass exactly, incrementally-streamed tokens
+      included (``completed + cancelled == accepted`` closes accounting).
+    """
+    import jax
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.observability import MetricsRegistry, Tracer
+    from perceiver_io_tpu.reliability import FakeClock
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    num_latents = min(4, cfg.max_latents)
+    max_len = min(
+        16, cfg.max_seq_len - new_tokens,
+        cfg.max_seq_len - cfg.max_latents + num_latents,
+    )
+    table = BucketTable(prompt_lens=(max_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=int(n)).astype(np.int32)
+        for n in rng.integers(max(num_latents, max_len // 2), max_len + 1,
+                              size=n_requests)
+    ]
+    step_cost_s = 0.01
+
+    def make_engine(clock, tracer, registry):
+        return SlotServingEngine(
+            model, params, gcfg, table, slots=slots, kv_layout="paged",
+            clock=clock, tracer=tracer, registry=registry,
+            rng=jax.random.PRNGKey(3),
+        )
+
+    # warm once; the reference pass and the drill reuse every executor
+    make_engine(FakeClock(), None, MetricsRegistry()).warmup()
+
+    # fault-free reference pass: the survivor-identity oracle
+    ref_engine = make_engine(FakeClock(), None, MetricsRegistry())
+    ref_out = ref_engine.serve(prompts)
+
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    registry = MetricsRegistry(clock=clock)
+    engine = make_engine(clock, tracer, registry)
+    streams = {}
+    for i, p in enumerate(prompts):
+        toks: list = []
+        req = engine.submit(
+            p, on_token=lambda idx, t, _toks=toks: _toks.append((idx, t))
+        )
+        streams[req.request_id] = {
+            "req": req, "tokens": toks, "victim": i % abandon_every == 0,
+            "token_at": None, "reclaim_ms": None,
+        }
+    abandoned = 0
+    reclaims = []
+    while engine.pending():
+        engine.step()
+        clock.advance(step_cost_s)
+        for s in streams.values():
+            if (
+                s["victim"] and not s["req"].done and s["reclaim_ms"] is None
+                and len(s["tokens"]) >= cancel_after_tokens
+            ):
+                if s["token_at"] is None:
+                    s["token_at"] = clock()  # noticed between steps
+                    continue  # the gateway notices on the NEXT pass
+                if engine.cancel(s["req"].request_id):
+                    s["reclaim_ms"] = (clock() - s["token_at"]) * 1e3
+                    reclaims.append(s["reclaim_ms"])
+                    abandoned += 1
+    engine.drain()
+    pool = engine._pool
+    survivors = [s for s in streams.values() if s["reclaim_ms"] is None]
+    # request ids are assigned in submit order, so sorted(streams) aligns
+    # 1:1 with the reference pass's output order
+    identical = all(
+        s["req"].status == "ok"
+        and np.array_equal(s["req"].result, ref)
+        and [t for _, t in s["tokens"]] == [
+            int(t) for t in ref[: len(s["tokens"])]
+        ]
+        for s, ref in (
+            (streams[rid], ref_out[j])
+            for j, rid in enumerate(sorted(streams))
+            if streams[rid]["reclaim_ms"] is None
+        )
+    )
+    counts = registry.counters()
+    completed = int(counts.get("serving_requests_completed_total", 0))
+    cancelled = int(counts.get("serving_requests_cancelled_total", 0))
+    reclaims_sorted = sorted(reclaims)
+    return {
+        "slots": slots,
+        "requests": n_requests,
+        "abandoned": abandoned,
+        "survivors": len(survivors),
+        "cancel_after_tokens": cancel_after_tokens,
+        "token_identical": bool(identical),
+        "accounting_closed": completed + cancelled == n_requests,
+        "completed": completed,
+        "cancelled": cancelled,
+        "reclaim": {
+            "p50_ms": round(
+                reclaims_sorted[len(reclaims_sorted) // 2], 3
+            ) if reclaims_sorted else None,
+            "p95_ms": round(
+                reclaims_sorted[
+                    min(len(reclaims_sorted) - 1,
+                        int(0.95 * len(reclaims_sorted)))
+                ], 3
+            ) if reclaims_sorted else None,
+            "max_ms": round(max(reclaims_sorted), 3) if reclaims_sorted else None,
+            "bound_ms": round(step_cost_s * 1e3, 3),  # one scheduler pass
+        },
+        "pool": {
+            "leaked": pool.leaked(),
+            "in_use_after_drain": pool.in_use,
+            "reserved_after_drain": pool.reserved,
+            "frees_by_cause": dict(sorted(pool.frees_by_cause.items())),
+            "high_water": pool.high_water,
+        },
+    }
+
+
 def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
                        new_tokens: int = 6, slots: int = 4,
-                       rate_factors=(0.5, 1.0, 2.0)):
+                       rate_factors=(0.5, 1.0, 2.0),
+                       transport: str = "inproc"):
     """Goodput-under-SLO sweep (docs/observability.md): offered load vs
     p95 TTFT / p95 inter-token latency through the slot engine, driven by
     the open-loop Poisson load generator — the serving-paper measurement
@@ -1593,7 +1755,14 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
 
     All accounting uses the shared offered-load goodput definition
     (``observability/slo.py``) — the same helper the fleet-chaos and
-    observability probes use, so the denominators cannot drift."""
+    observability probes use, so the denominators cannot drift.
+
+    ``transport`` is the one-flag in-process/over-sockets switch
+    (docs/serving.md "Streaming"): ``"inproc"`` drives the engine
+    directly; ``"http"`` runs every point through a real
+    :class:`~perceiver_io_tpu.serving.StreamingGateway` socket via
+    :class:`~perceiver_io_tpu.observability.GatewayHttpClient`, so the
+    sweep's TTFT is socket-anchored and the report gains bytes-on-wire."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1601,6 +1770,7 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
     from perceiver_io_tpu.inference import cast_float_params
     from perceiver_io_tpu.inference.generate import GenerationConfig
     from perceiver_io_tpu.observability import (
+        GatewayHttpClient,
         LoadGenerator,
         MetricsRegistry,
         Tracer,
@@ -1608,8 +1778,10 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
         goodput_ratio,
     )
     from perceiver_io_tpu.observability import report as obs_report
-    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine, StreamingGateway
 
+    if transport not in ("inproc", "http"):
+        raise ValueError(f"transport must be 'inproc' or 'http', got {transport!r}")
     params = cast_float_params(params, jnp.bfloat16)
     num_latents = min(4, cfg.max_latents)
     max_len = min(
@@ -1631,12 +1803,26 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
             model, params, gcfg, table, slots=slots,
             registry=registry, tracer=tracer, rng=jax.random.PRNGKey(2),
         )
+        gateway = None
+        driver = engine
+        if transport == "http":
+            # the full network path: the gateway drives the engine from
+            # its own loop, the load generator offers over real sockets,
+            # and TTFT anchors at socket accept (same registry, so the
+            # percentile reads below are transport-independent)
+            gateway = StreamingGateway(engine, tracer=tracer).run_in_thread()
+            driver = GatewayHttpClient(gateway.host, gateway.port)
         gen = LoadGenerator(
-            engine, workload=workload, mode=mode, arrival="poisson",
+            driver, workload=workload, mode=mode, arrival="poisson",
             rate_rps=rate_rps, users=slots, max_requests=requests_per_rate,
             config=gcfg, rng=seed,
         )
-        return registry, tracer, gen, gen.run()
+        try:
+            report = gen.run()
+        finally:
+            if gateway is not None:
+                gateway.close()
+        return registry, tracer, gen, report
 
     # warm every executor once up front — the sweep measures serving, not
     # compiles (caches are process-global, so later engines reuse them)
@@ -1699,6 +1885,7 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
             ),
             "goodput_rps": round(good / rep["span_s"], 4),
             "goodput_ratio": round(goodput_ratio(registry.counters()), 4),
+            "bytes_on_wire": rep.get("bytes_on_wire"),
         })
     knee_idx = max(
         range(len(sweep)), key=lambda i: (sweep[i]["goodput_rps"], -i)
@@ -1706,6 +1893,7 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
     return {
         "slots": slots,
         "requests_per_rate": requests_per_rate,
+        "transport": transport,
         "slo": {"ttft_p95_ms": slo_ttft_ms, "inter_token_p95_ms": slo_itl_ms},
         "calibration": {
             "base_rps": round(base_rps, 3),

@@ -51,7 +51,12 @@ from perceiver_io_tpu.observability.ledger import (
     LedgeredExecutor,
     default_ledger,
 )
-from perceiver_io_tpu.observability.loadgen import LoadGenerator, WorkloadSpec
+from perceiver_io_tpu.observability.loadgen import (
+    GatewayHttpClient,
+    HttpStreamHandle,
+    LoadGenerator,
+    WorkloadSpec,
+)
 from perceiver_io_tpu.observability.registry import (
     Histogram,
     MetricsRegistry,
@@ -105,8 +110,10 @@ class ObservabilityArgs:
 
 __all__ = [
     "CompileLedger",
+    "GatewayHttpClient",
     "HELP_TEXT",
     "Histogram",
+    "HttpStreamHandle",
     "JsonlSpanSink",
     "LedgeredExecutor",
     "LoadGenerator",

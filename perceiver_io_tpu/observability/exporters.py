@@ -40,6 +40,8 @@ HELP_TEXT = {
     "serving_requests_timed_out_total": "Requests whose deadline expired before completion.",
     "serving_requests_failed_total": "Requests failed by an executor or injected fault.",
     "serving_requests_rejected_total": "Submissions rejected as infeasible (empty / over the largest bucket).",
+    "serving_requests_cancelled_total": "Requests withdrawn mid-flight via cancel() (gateway client disconnects).",
+    "serving_token_sink_errors_total": "Per-request on_token sinks that raised and were isolated.",
     "serving_batches_total": "Micro-batches executed by the bucket engine.",
     "serving_tokens_generated_total": "Real (non-filler) tokens generated across requests.",
     "serving_prompt_tokens_real_total": "Prompt tokens submitted by callers.",
@@ -100,6 +102,7 @@ HELP_TEXT = {
     "fleet_requests_timed_out_total": "Fleet requests whose deadline expired before completion.",
     "fleet_requests_failed_total": "Fleet requests failed terminally (failover budget spent or failover off).",
     "fleet_requests_rejected_total": "Submissions rejected as infeasible at the fleet front door.",
+    "fleet_requests_cancelled_total": "Fleet requests withdrawn mid-flight via cancel() (gateway client disconnects).",
     "fleet_dispatch_total": "Successful request placements onto a replica.",
     "fleet_failover_total": "Replica-failure events that re-dispatched in-flight work.",
     "fleet_redispatch_total": "Requests re-queued for replay on another replica.",
@@ -111,6 +114,15 @@ HELP_TEXT = {
     "fleet_replicas": "Replicas owned by the fleet router.",
     "fleet_replicas_healthy": "Replicas with a closed circuit breaker right now.",
     "fleet_request_latency_ms": "Fleet request latency: submit to terminal state (failovers included).",
+    "gateway_connections_total": "TCP connections accepted by the HTTP streaming gateway.",
+    "gateway_connections_active": "Gateway connections open right now.",
+    "gateway_streams_total": "Generate streams accepted (submission admitted, response streaming).",
+    "gateway_streams_active": "Generate streams currently in flight.",
+    "gateway_streams_completed_total": "Streams whose request reached a server-side terminal state.",
+    "gateway_streams_cancelled_total": "Streams abandoned by the client mid-generation (request cancelled, slot + pool pages freed).",
+    "gateway_streams_rejected_total": "Generate submissions answered 400/503 (infeasible or shed) without becoming streams.",
+    "gateway_bytes_sent_total": "Bytes written to gateway sockets (token events, terminals, error/metrics responses).",
+    "gateway_socket_ttft_ms": "Socket-anchored time to first token: connection accept to the first token byte written.",
 }
 
 #: prefix-matched fallbacks for generated families (per-reason counters,

@@ -36,13 +36,30 @@ bit-identically with zero sleeps (tests/test_slo.py pins this). With a
 real clock it sleeps instead, and the measured latencies are real.
 
 The report (:meth:`LoadGenerator.run`) carries the shared
-goodput-under-SLO accounting (:func:`~perceiver_io_tpu.observability.slo.offered_load`):
-offered = accepted + shed + rejected, so saturation shows up as goodput
-< 1, never as a shrunk denominator.
+goodput-under-SLO accounting — computed through
+:func:`~perceiver_io_tpu.observability.slo.offered_load` /
+:func:`~perceiver_io_tpu.observability.slo.goodput_ratio`, the SAME
+helpers the bench probes and ``obs report`` use: offered = accepted +
+shed + rejected, so saturation shows up as goodput < 1, never as a
+shrunk denominator.
+
+**HTTP client mode** (docs/serving.md "Streaming"): point the generator
+at a :class:`GatewayHttpClient` instead of an engine and the whole drill
+runs over real sockets — POST ``/v1/generate`` per request, streamed
+tokens read off the wire, shed/reject mapped back from 503/400 — so the
+``extras.slo_goodput`` sweep measures goodput-under-SLO through the full
+network path (socket-anchored TTFT included) with ONE flag flipped. The
+client reports ``bytes_on_wire`` (response bytes received), which
+:meth:`LoadGenerator.run` surfaces beside offered/completed. HTTP mode
+requires a real clock: sockets cannot be driven by a
+:class:`~perceiver_io_tpu.reliability.FakeClock`.
 """
 from __future__ import annotations
 
 import dataclasses
+import http.client
+import json
+import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
@@ -78,6 +95,179 @@ class WorkloadSpec:
     def sample_think(self, rng: np.random.Generator) -> float:
         lo, hi = self.think_time_s
         return lo if hi <= lo else float(rng.uniform(lo, hi))
+
+
+class HttpStreamHandle:
+    """One in-flight HTTP stream: the client-side mirror of a
+    ``ServeRequest`` handle — ``status`` / ``done`` / ``result`` — fed by a
+    background reader thread consuming the gateway's SSE / JSON-lines
+    response. ``result`` holds the streamed token ids (unpadded)."""
+
+    def __init__(self, request_index: int):
+        self.request_index = request_index
+        self.tokens: List[int] = []
+        self.status = "queued"
+        self.error: Optional[str] = None
+        self.trace_id: Optional[str] = None
+        self.bytes_received = 0
+        self.result: Optional[np.ndarray] = None
+        self.first_token_at: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status not in ("queued",)
+
+
+class GatewayHttpClient:
+    """Engine-surface adapter over a :class:`~perceiver_io_tpu.serving.gateway.StreamingGateway`
+    address: ``submit`` POSTs ``/v1/generate`` and returns an
+    :class:`HttpStreamHandle` whose tokens stream in on a reader thread;
+    ``step``/``pending`` satisfy the :class:`LoadGenerator` drive loop (the
+    SERVER drives the engine — the client's ``step`` just yields).
+
+    Admission mapping mirrors the in-process surface so the generator's
+    offered/shed/rejected accounting is transport-independent: HTTP 503
+    (bounded-queue backpressure) raises
+    :class:`~perceiver_io_tpu.reliability.QueueFull`, HTTP 400 (infeasible
+    prompt) raises ``ValueError`` — both at submit time, read from the
+    response head before the body streams.
+
+    :param host / port: the gateway's bound address.
+    :param mode: wire framing requested per stream (``jsonl`` parses
+        cheapest; ``sse`` exercises the event framing).
+    :param clock: time source for ``first_token_at`` stamps (client-side
+        TTFT; the authoritative socket-anchored number lives on the
+        server's ``serving_ttft_ms``).
+    :param timeout_s: socket timeout per connection.
+    """
+
+    def __init__(self, host: str, port: int, *, mode: str = "jsonl",
+                 clock: Callable[[], float] = time.monotonic,
+                 timeout_s: float = 60.0):
+        if mode not in ("sse", "jsonl"):
+            raise ValueError(f"mode must be 'sse' or 'jsonl', got {mode!r}")
+        self.host = host
+        self.port = int(port)
+        self.mode = mode
+        self._clock = clock
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        #: handles not yet terminal — pruned on every pending() poll so the
+        #: per-millisecond drive loop never rescans the whole run's history
+        self._live_handles: List[HttpStreamHandle] = []
+        self._next_index = 0
+        #: total response-body bytes read off the wire — the
+        #: bytes-on-wire number :meth:`LoadGenerator.run` reports
+        self.bytes_received = 0
+
+    def submit(self, prompt, config=None, *, deadline_s: Optional[float] = None,
+               **_ignored) -> HttpStreamHandle:
+        from perceiver_io_tpu.reliability import QueueFull
+
+        body: dict = {"prompt_ids": np.asarray(prompt, np.int32).reshape(-1).tolist(),
+                      "stream": self.mode}
+        if config is not None:
+            body["max_new_tokens"] = int(config.max_new_tokens)
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        try:
+            conn.request(
+                "POST", "/v1/generate", body=json.dumps(body),
+                headers={"Content-Type": "application/json"},
+            )
+            # the gateway answers the head as soon as admission decides, so
+            # shed/reject surface synchronously — the loadgen accounting
+            # point
+            resp = conn.getresponse()
+        except OSError as e:
+            # a transient connect failure / socket timeout is ONE failed
+            # request, not the end of the whole offered-load run: return a
+            # terminal handle so the generator's accounting absorbs it
+            conn.close()
+            handle = HttpStreamHandle(self._next_index)
+            self._next_index += 1
+            handle.status = "failed"
+            handle.error = f"{type(e).__name__}: {e}"
+            return handle
+        if resp.status == 503:
+            detail = resp.read().decode(errors="replace")
+            conn.close()
+            raise QueueFull(f"gateway backpressure (503): {detail.strip()}")
+        if resp.status != 200:
+            detail = resp.read().decode(errors="replace")
+            conn.close()
+            raise ValueError(
+                f"gateway rejected the request ({resp.status}): {detail.strip()}"
+            )
+        handle = HttpStreamHandle(self._next_index)
+        self._next_index += 1
+        self._live_handles.append(handle)
+        threading.Thread(
+            target=self._read_stream, args=(conn, resp, handle), daemon=True
+        ).start()
+        return handle
+
+    def _read_stream(self, conn, resp, handle: HttpStreamHandle) -> None:
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    # EOF without a terminal record: the server went away
+                    if not handle.done:
+                        handle.status = "failed"
+                        handle.error = "stream ended without a terminal record"
+                    break
+                with self._lock:
+                    self.bytes_received += len(line)
+                    handle.bytes_received += len(line)
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith(b"data:"):  # SSE framing
+                    line = line[5:].strip()
+                record = json.loads(line)
+                if record.get("done"):
+                    handle.trace_id = record.get("trace_id")
+                    handle.error = record.get("error")
+                    handle.result = np.asarray(handle.tokens, np.int32)
+                    handle.status = record.get("status", "failed")
+                    break
+                if handle.first_token_at is None:
+                    handle.first_token_at = self._clock()
+                handle.tokens.append(int(record["token"]))
+        except Exception as e:
+            if not handle.done:
+                handle.status = "failed"
+                handle.error = f"{type(e).__name__}: {e}"
+        finally:
+            conn.close()
+
+    def step(self) -> int:
+        """The server drives the engine; the client's step just yields so
+        the drive loop doesn't spin."""
+        time.sleep(0.001)
+        return 0
+
+    def pending(self) -> bool:
+        # reader threads flip handle.status; a racy read only delays one
+        # polling pass, never deadlocks the drive loop. Terminal handles
+        # are pruned here so the poll stays O(in-flight), not O(run).
+        self._live_handles = [h for h in self._live_handles if not h.done]
+        return bool(self._live_handles)
+
+    def health(self) -> dict:
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            return json.loads(resp.read().decode())
+        finally:
+            conn.close()
 
 
 class LoadGenerator:
@@ -279,6 +469,8 @@ class LoadGenerator:
         disposition counts from the request handles, wall span on the
         run's clock, and the achieved rates. ``handles`` stays on the
         instance for per-request inspection."""
+        from perceiver_io_tpu.observability.slo import goodput_ratio, offered_load
+
         t0 = self._clock()
         if self.mode == "open":
             self._run_open()
@@ -289,22 +481,36 @@ class LoadGenerator:
         for h in self.handles:
             by_status[h.status] = by_status.get(h.status, 0) + 1
         completed = by_status.get("ok", 0)
+        # the shared goodput definition (observability/slo.py): the
+        # generator's own accounting rendered as the counter mapping the
+        # helpers read, so in-process, fleet, and over-socket drills all
+        # share ONE denominator (shed and rejected stay in it)
+        counts = {
+            "serving_requests_submitted_total": len(self.handles),
+            "serving_requests_shed_total": self.shed,
+            "serving_requests_rejected_total": self.rejected,
+            "serving_requests_completed_total": completed,
+        }
         return {
             "mode": self.mode,
             "arrival": self.arrival if self.mode == "open" else None,
-            "offered": self.offered,
+            "offered": offered_load(counts),
             "accepted": len(self.handles),
             "shed": self.shed,
             "rejected": self.rejected,
             "completed": completed,
             "timed_out": by_status.get("timed_out", 0),
             "failed": by_status.get("failed", 0),
+            "cancelled": by_status.get("cancelled", 0),
             "by_status": dict(sorted(by_status.items())),
             "span_s": round(span_s, 6),
             "offered_rps": round(self.offered / span_s, 4),
             "completed_rps": round(completed / span_s, 4),
-            # the shared goodput definition: completed / offered
-            # (observability/slo.py — shed and rejected stay in the
-            # denominator)
-            "goodput_ratio": round(completed / max(1, self.offered), 4),
+            "goodput_ratio": round(goodput_ratio(counts), 4),
+            # over-socket drills (GatewayHttpClient) report response bytes
+            # read off the wire; None for in-process engines
+            "bytes_on_wire": (
+                int(self.engine.bytes_received)
+                if hasattr(self.engine, "bytes_received") else None
+            ),
         }

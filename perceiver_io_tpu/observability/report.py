@@ -139,6 +139,106 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
         "fleet": _fleet_section(events, snapshot),
         "kv_pool": _kv_pool_section(snapshot),
         "slo": _slo_section(events, snapshot),
+        "gateway": _gateway_section(events, snapshot),
+    }
+
+
+def _gateway_section(events: List[dict], snapshot: dict) -> Optional[dict]:
+    """HTTP streaming gateway rollup (docs/serving.md "Streaming"): the
+    connection/stream table from the ``gateway_*`` counters, per-stream
+    outcomes from the ``gateway.request`` events, cancellation accounting
+    (``serving.cancelled`` events + the cancelled counters), and the
+    socket-vs-engine TTFT delta — ``gateway_socket_ttft_ms`` measures
+    accept → first token byte on the wire, ``serving_ttft_ms`` is anchored
+    at the same accept instant but ends when the ENGINE materializes the
+    token, so the difference is the response-path overhead. None when the
+    run had no gateway (pre-gateway artifacts stay unchanged)."""
+    counters = snapshot.get("counters") or {}
+    gauges = snapshot.get("gauges") or {}
+    hists = snapshot.get("histograms") or {}
+    gw_events = [r for r in events if r.get("span") == "gateway.request"]
+    cancel_events = [r for r in events if r.get("span") == "serving.cancelled"]
+    has_gateway = gw_events or any(k.startswith("gateway_") for k in counters)
+    if not has_gateway:
+        return None
+
+    def c(name: str) -> Optional[int]:
+        v = counters.get(name)
+        return None if v is None else int(v)
+
+    by_status: Dict[str, int] = {}
+    tokens = 0
+    stream_bytes = 0
+    for r in gw_events:
+        status = r.get("status", "?")
+        by_status[status] = by_status.get(status, 0) + 1
+        attrs = r.get("attrs") or {}
+        tokens += int(attrs.get("tokens") or 0)
+        stream_bytes += int(attrs.get("bytes") or 0)
+
+    def summ(name: str) -> Optional[dict]:
+        h = hists.get(name)
+        if h is None:
+            return None
+        return {
+            "count": h.get("count"), "p50_ms": h.get("p50"),
+            "p95_ms": h.get("p95"), "max_ms": h.get("max"),
+        }
+
+    socket_ttft = summ("gateway_socket_ttft_ms")
+    engine_ttft = summ("serving_ttft_ms")
+    ttft_delta = None
+    if socket_ttft and engine_ttft:
+        ttft_delta = {
+            q: (
+                None
+                if socket_ttft[q] is None or engine_ttft[q] is None
+                else round(socket_ttft[q] - engine_ttft[q], 3)
+            )
+            for q in ("p50_ms", "p95_ms")
+        }
+    # events-only fallback (the slo/fleet-section stance): with no snapshot,
+    # the gateway.request events still yield the stream table. "completed"
+    # means SERVER-SIDE terminal reached (ok/failed/timed_out alike — the
+    # live gateway_streams_completed_total semantics), so it is everything
+    # that was not client-cancelled; by_status carries the breakdown.
+    streams_total = c("gateway_streams_total")
+    streams_completed = c("gateway_streams_completed_total")
+    streams_cancelled = c("gateway_streams_cancelled_total")
+    source = "snapshot"
+    if streams_total is None and gw_events:
+        source = "events"
+        streams_total = len(gw_events)
+        streams_cancelled = by_status.get("cancelled", 0)
+        streams_completed = streams_total - streams_cancelled
+    return {
+        "source": source,
+        "connections": {
+            "total": c("gateway_connections_total"),
+            "active": (
+                None if gauges.get("gateway_connections_active") is None
+                else int(gauges["gateway_connections_active"])
+            ),
+        },
+        "streams": {
+            "total": streams_total,
+            "completed": streams_completed,
+            "cancelled": streams_cancelled,
+            "rejected": c("gateway_streams_rejected_total"),
+            "by_status": dict(sorted(by_status.items())),
+            "events": len(gw_events),
+            "tokens_streamed": tokens,
+            "stream_bytes": stream_bytes,
+        },
+        "cancellations": {
+            "events": len(cancel_events),
+            "requests_cancelled": c("serving_requests_cancelled_total"),
+            "fleet_requests_cancelled": c("fleet_requests_cancelled_total"),
+        },
+        "bytes_sent": c("gateway_bytes_sent_total"),
+        "socket_ttft": socket_ttft,
+        "engine_ttft": engine_ttft,
+        "socket_vs_engine_ttft_delta_ms": ttft_delta,
     }
 
 
@@ -610,6 +710,55 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"resident {kv['resident_bytes']:,} B of worst-case "
                 f"{kv['capacity_bytes']:,} B "
                 f"({kv['resident_bytes'] / kv['capacity_bytes']:.1%})"
+            )
+
+    gw = analysis.get("gateway")
+    if gw:
+        out.append("")
+        out.append("== gateway ==")
+        conns = gw["connections"]
+        streams = gw["streams"]
+
+        def v(value):
+            return "-" if value is None else value
+
+        out.append(
+            f"connections: {v(conns['total'])} total"
+            + (f" ({conns['active']} active)" if conns["active"] is not None else "")
+            + f"  bytes sent: {v(gw['bytes_sent'])}"
+        )
+        out.append(
+            f"streams: {v(streams['total'])} accepted  "
+            f"completed={v(streams['completed'])}  "
+            f"cancelled={v(streams['cancelled'])}  "
+            f"rejected={v(streams['rejected'])}"
+            + (
+                "  by status: "
+                + ", ".join(f"{k}={n}" for k, n in streams["by_status"].items())
+                if streams["by_status"] else ""
+            )
+            + ("  (from events)" if gw.get("source") == "events" else "")
+        )
+        canc = gw["cancellations"]
+        out.append(
+            f"cancellations: {canc['events']} serving.cancelled events, "
+            f"requests_cancelled={v(canc['requests_cancelled'])}"
+            + (
+                f", fleet={canc['fleet_requests_cancelled']}"
+                if canc["fleet_requests_cancelled"] is not None else ""
+            )
+        )
+        if gw["socket_ttft"]:
+            s = gw["socket_ttft"]
+            out.append(
+                f"socket ttft ms: p50={s['p50_ms']} p95={s['p95_ms']} "
+                f"(n={s['count']})"
+            )
+        if gw["socket_vs_engine_ttft_delta_ms"]:
+            d = gw["socket_vs_engine_ttft_delta_ms"]
+            out.append(
+                f"socket-vs-engine ttft delta ms: p50={d['p50_ms']} "
+                f"p95={d['p95_ms']} (response-path overhead)"
             )
 
     pad = analysis["padding"]

@@ -33,6 +33,15 @@ site                        keying
                             clock by ``delay_s``, tripping the router's
                             ``step_timeout_s`` wall-time deadline — the
                             hung-replica drill
+``gateway.disconnect.<s>``  per-stream execution count (1-based): the Nth
+                            token about to go onto stream ``s``'s socket
+                            (accept order assigns stream ids). ``error``
+                            models the client vanishing mid-generation —
+                            the gateway aborts the connection and
+                            propagates a :meth:`cancel` to the engine,
+                            freeing the slot and its pool pages — the
+                            mass-abandonment drill
+                            (:meth:`ChaosRegistry.disconnect_stream`)
 ==========================  =============================================
 
 Fault kinds: ``"error"`` (the site raises — or records — an exception),
@@ -176,6 +185,17 @@ class ChaosRegistry:
         duplicate-completion case the router's request-id dedupe absorbs."""
         return self.add(f"fleet.replica_step.{replica_id}", "hang", at_step,
                         delay_s=delay_s)
+
+    def disconnect_stream(self, stream_id: int, *, after_tokens: int) -> Fault:
+        """Abandon gateway stream ``stream_id`` mid-generation: the gateway
+        consults ``gateway.disconnect.<stream_id>`` once per token about to
+        go on the wire (1-based), so the fault fires just before the
+        ``after_tokens``-th token is written — the client "vanishes", the
+        connection is torn down, and the gateway cancels the engine request
+        (slot retired + pool pages returned; docs/serving.md "Streaming")."""
+        if after_tokens < 1:
+            raise ValueError(f"after_tokens must be >= 1, got {after_tokens}")
+        return self.add(f"gateway.disconnect.{stream_id}", "error", after_tokens)
 
     def fail_dispatch(self, attempt: int, *, count: int = 1) -> Fault:
         """Fail the router's ``attempt``-th dispatch attempt (1-based,

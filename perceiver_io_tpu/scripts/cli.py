@@ -153,6 +153,29 @@ class LRSchedulerArgs:
 
 
 @dataclasses.dataclass
+class HttpArgs:
+    """``--serve.http.*``: the async HTTP/SSE streaming gateway
+    (docs/serving.md "Streaming"). Setting ``--serve.http.port`` switches
+    ``serve`` from the prompts-file/stdin batch loop to a network server:
+    ``POST /v1/generate`` streams each token as it decodes, ``GET
+    /healthz`` is the load-balancer probe, ``GET /metrics`` the Prometheus
+    scrape. Client disconnects cancel the request mid-generation (slot +
+    KV pool pages freed); TTFT is anchored at socket accept."""
+
+    #: bind port; set it to enable gateway mode (0 = ephemeral, printed to
+    #: stderr). None (default) keeps the batch prompts loop.
+    port: Optional[int] = None
+    host: str = "127.0.0.1"
+    #: default wire framing: ``sse`` (Server-Sent Events) or ``jsonl``
+    #: (one JSON object per line); per-request override via the body's
+    #: ``"stream"`` field
+    stream: str = "sse"
+    #: shut the gateway down after this many streams reach a terminal
+    #: state (scripted runs / tests); None = serve until interrupted
+    max_streams: Optional[int] = None
+
+
+@dataclasses.dataclass
 class ServeArgs:
     """``--serve.*`` flags for the ``serve`` subcommand: bucketed text
     generation over a ``save_pretrained`` checkpoint (docs/serving.md)."""
@@ -234,6 +257,9 @@ class ServeArgs:
     #: RETURNS is out of scope for the in-line supervisor — see
     #: docs/serving.md.
     step_timeout_s: Optional[float] = None
+    #: the ``--serve.http.*`` sub-group: the async HTTP/SSE streaming
+    #: gateway (docs/serving.md "Streaming"); off unless ``http.port`` set
+    http: HttpArgs = dataclasses.field(default_factory=HttpArgs)
 
 
 def _serve_decode_mode(flag_value: str) -> str:
@@ -940,6 +966,18 @@ class CLI:
                 ):
                     strategy_mod.save_registry(args.decode_strategy_file)
 
+            if args.http.port is not None:
+                # gateway mode (docs/serving.md "Streaming"): serve over
+                # HTTP instead of the prompts loop — requests arrive on
+                # sockets, tokens stream back as they decode
+                if args.prompts:
+                    raise SystemExit(
+                        "--serve.prompts applies to the batch loop; with "
+                        "--serve.http.port set, prompts arrive over "
+                        "POST /v1/generate"
+                    )
+                return self._serve_http(engine, tok, args, kit)
+
             if args.prompts:
                 with open(args.prompts) as fh:
                     prompts = [line.rstrip("\n") for line in fh if line.strip()]
@@ -958,6 +996,74 @@ class CLI:
                 kit["snapshot_writer"].maybe_write(force=True)
             if kit["sink"] is not None:
                 kit["sink"].close()
+
+    def _serve_http(self, engine, tok, args, kit) -> list:
+        """``serve --serve.http.port=N``: run the async HTTP/SSE streaming
+        gateway over the built engine/fleet until ``--serve.http.max_streams``
+        terminal streams (or Ctrl-C), then drain and print the final
+        ``serve_stats`` line — gateway wire counters included."""
+        import json
+        import time
+
+        from perceiver_io_tpu.serving.gateway import STREAM_MODES, StreamingGateway
+
+        if args.http.stream not in STREAM_MODES:
+            raise SystemExit(
+                "--serve.http.stream must be one of "
+                f"{'|'.join(STREAM_MODES)}, got {args.http.stream!r}"
+            )
+        t0 = time.monotonic()
+        gateway = StreamingGateway(
+            engine,
+            host=args.http.host,
+            port=args.http.port,
+            stream=args.http.stream,
+            encode=lambda text: tok.encode(text),
+            decode=lambda ids: tok.decode(ids),
+            registry=kit["registry"],
+            tracer=engine.tracer if hasattr(engine, "tracer") else None,
+            slo_monitor=kit["slo_monitor"],
+            snapshot_writer=kit["snapshot_writer"],
+            max_streams=args.http.max_streams,
+        )
+        gateway.run_in_thread()
+        print(
+            f"[serve] http gateway listening on {gateway.host}:{gateway.port} "
+            f"(stream={args.http.stream}"
+            + (f", max_streams={args.http.max_streams}"
+               if args.http.max_streams is not None else "")
+            + ")",
+            file=sys.stderr, flush=True,
+        )
+        try:
+            gateway.wait()
+        except KeyboardInterrupt:
+            print("[serve] interrupt: shutting the gateway down",
+                  file=sys.stderr, flush=True)
+        finally:
+            gateway.close()
+        engine.drain()
+        if kit["slo_monitor"] is not None:
+            # unconditional final poll (the _serve_prompts convention): the
+            # fleet router polls at the START of each step, so the last
+            # drain step's dispositions would otherwise never be diffed
+            # into the monitor's error window
+            kit["slo_monitor"].poll()
+        wall_s = time.monotonic() - t0
+        if args.stats:
+            from perceiver_io_tpu.observability import default_ledger, default_registry
+
+            stats = engine.stats()
+            stats["health"] = engine.health()
+            stats["wall_s"] = round(wall_s, 3)
+            stats["gateway"] = gateway.stats()
+            stats["metrics"] = engine.registry.snapshot()
+            stats["compile_ledger"] = default_ledger().snapshot()
+            stats["process_metrics"] = default_registry().snapshot()
+            if kit["slo_monitor"] is not None and "slo" not in stats:
+                stats["slo"] = kit["slo_monitor"].stats()
+            print(json.dumps({"serve_stats": stats}), flush=True)
+        return []
 
     def _serve_prompts(self, engine, tok, prompts, args, kit) -> list:
         import json
@@ -1078,6 +1184,11 @@ class CLI:
               "--serve.max_queue --serve.deadline_s "
               "--serve.replicas=<n> --serve.failover={true|false} "
               "--serve.step_timeout_s=<s>")
+        print("serve http gateway: --serve.http.port=<n|0> --serve.http.host "
+              "--serve.http.stream={sse|jsonl} --serve.http.max_streams — "
+              "POST /v1/generate streams tokens as they decode; GET /healthz, "
+              "GET /metrics; client disconnects cancel mid-generation "
+              "(docs/serving.md)")
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "
               "--obs.snapshot_path --obs.profile_on_regress_factor "
               "(fit and serve; docs/observability.md)")

@@ -13,6 +13,10 @@ generation executors.
   crash/hang failure detection, and exactly-once failover that replays
   in-flight requests from their prompts (token-identical under greedy
   decoding).
+- :class:`StreamingGateway` — the stdlib-only asyncio HTTP/1.1 front
+  end: per-token SSE / JSON-lines streaming out of ``step()``,
+  socket-anchored TTFT, and client-disconnect cancellation that frees
+  slots and KV pool pages mid-generation.
 
 All are hardened for load (docs/reliability.md): bounded queue with
 :class:`QueueFull` backpressure, per-request deadlines, per-request error
@@ -28,6 +32,7 @@ from perceiver_io_tpu.serving.fleet import (
     FleetRouter,
     Replica,
 )
+from perceiver_io_tpu.serving.gateway import StreamingGateway
 from perceiver_io_tpu.serving.kv_pool import KVPagePool, PoolExhausted
 from perceiver_io_tpu.serving.slots import SlotServingEngine
 
@@ -44,4 +49,5 @@ __all__ = [
     "ServeRequest",
     "ServingEngine",
     "SlotServingEngine",
+    "StreamingGateway",
 ]

@@ -115,7 +115,7 @@ class _SafeCapture:
 #: Pinned by the contract test in ``tests/test_fleet.py``.
 HEALTH_KEYS = frozenset({
     "ready", "accepting", "queue_depth", "max_queue", "oldest_wait_ms",
-    "completed", "shed", "timed_out", "failed",
+    "completed", "shed", "timed_out", "failed", "cancelled",
 })
 
 #: canonical registry counter names -> the legacy ``stats()`` keys they
@@ -127,6 +127,7 @@ STAT_ALIASES = {
     "serving_requests_timed_out_total": "timed_out",
     "serving_requests_failed_total": "failed",
     "serving_requests_rejected_total": "rejected",
+    "serving_requests_cancelled_total": "cancelled",
     "serving_batches_total": "batches",
     "serving_tokens_generated_total": "tokens_generated",
 }
@@ -142,8 +143,10 @@ class ServeRequest:
 
     ``status`` is ``"queued"`` until the scheduler disposes of the request:
     ``"ok"`` (``result`` holds the generated row), ``"timed_out"`` (deadline
-    expired before a bucket slot ran it), or ``"failed"`` (``error`` holds
-    the reason; its micro-batch peers are unaffected).
+    expired before a bucket slot ran it), ``"cancelled"`` (the caller
+    withdrew it via :meth:`ServingEngine.cancel` — the streaming gateway's
+    client-disconnect path), or ``"failed"`` (``error`` holds the reason;
+    its micro-batch peers are unaffected).
     """
 
     request_id: int
@@ -153,18 +156,29 @@ class ServeRequest:
     deadline_at: Optional[float] = None  # absolute, in engine-clock seconds
     started_at: Optional[float] = None
     result: Optional[np.ndarray] = None  # (max_new_tokens,) ids, pad after EOS
-    status: str = "queued"  # queued | ok | timed_out | failed
+    status: str = "queued"  # queued | ok | timed_out | cancelled | failed
     error: Optional[str] = None
     #: per-request trace ID (None when the engine has no tracer) — the join
     #: key between the serve CLI's JSON lines and events.jsonl
     trace_id: Optional[str] = None
     #: TTFT measurement anchor on the engine clock — defaults to
     #: ``submitted_at``. The fleet router backdates it to the FLEET submit
-    #: time at dispatch, so time-to-first-token stays the user-facing
-    #: number (front door → first token) instead of resetting at each
-    #: replica handoff. Queue-wait / request-latency accounting keeps
-    #: using ``submitted_at`` — those attribute THIS engine's share.
+    #: time at dispatch (and the HTTP gateway to the SOCKET accept
+    #: instant), so time-to-first-token stays the user-facing number
+    #: (front door → first token) instead of resetting at each replica
+    #: handoff. Queue-wait / request-latency accounting keeps using
+    #: ``submitted_at`` — those attribute THIS engine's share.
     ttft_anchor_s: Optional[float] = None
+    #: optional per-request incremental token sink (docs/serving.md
+    #: "Streaming"): called ``on_token(index, token_id)`` the moment a REAL
+    #: token for this request materializes — per token step on the slot
+    #: engine, once per token at batch completion on the bucket engine
+    #: (batch granularity). Indices restart at 0 when a fleet failover
+    #: replays the request; greedy determinism makes the replayed prefix
+    #: identical, so stream consumers dedupe by index. A raising sink is
+    #: isolated (``serving_token_sink_errors_total``), never failing the
+    #: request it observes.
+    on_token: Optional[Callable[[int, int], None]] = None
 
     @property
     def ttft_from_s(self) -> float:
@@ -308,7 +322,9 @@ class ServingEngine:
     # -- queue front --------------------------------------------------------
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
                *, deadline_s: Optional[float] = None,
-               ttft_anchor_s: Optional[float] = None) -> ServeRequest:
+               ttft_anchor_s: Optional[float] = None,
+               on_token: Optional[Callable[[int, int], None]] = None
+               ) -> ServeRequest:
         """Enqueue one prompt (1-D token ids); returns its request handle.
 
         Raises ``ValueError`` for infeasible prompts (empty, or longer than
@@ -317,7 +333,9 @@ class ServingEngine:
         ``max_queue`` (the request is shed and counted, not enqueued).
         ``ttft_anchor_s`` backdates the TTFT measurement to an earlier
         instant on the same clock (the fleet router passes its front-door
-        submit time — see :class:`ServeRequest`).
+        submit time; the HTTP gateway its socket-accept time — see
+        :class:`ServeRequest`). ``on_token`` installs the request's
+        incremental token sink (:attr:`ServeRequest.on_token`).
         """
         if not self._accepting:
             raise RuntimeError("engine is draining; new submissions rejected")
@@ -350,6 +368,7 @@ class ServingEngine:
             deadline_at=None if deadline_s is None else now + deadline_s,
             trace_id=self.tracer.new_trace_id() if self.tracer else None,
             ttft_anchor_s=ttft_anchor_s,
+            on_token=on_token,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -436,6 +455,38 @@ class ServingEngine:
         self._accepting = False
         return self.run_until_idle()
 
+    # -- streaming -----------------------------------------------------------
+    def _emit_token(self, req: ServeRequest, index: int, token: int) -> None:
+        """Deliver one token to the request's incremental sink. A raising
+        sink (a torn-down stream consumer) is isolated and counted — the
+        request it observes must finish normally."""
+        try:
+            req.on_token(index, token)
+        except Exception:
+            self.registry.inc("serving_token_sink_errors_total")
+
+    def cancel(self, request_id: int) -> bool:
+        """Withdraw one request — the streaming gateway's client-disconnect
+        retirement route (docs/serving.md). A queued request leaves the
+        queue and finishes ``cancelled`` (one terminal span, a
+        ``serving.cancelled`` event, ``serving_requests_cancelled_total``).
+        The bucket engine schedules whole micro-batches, so a request
+        already packed into a running batch cannot be interrupted — it
+        completes and the caller discards the result; the slot engine
+        overrides this with token-granular mid-generation cancellation.
+        Returns True when the request was found live and cancelled."""
+        for i, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[i]
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "serving.cancelled", trace_id=req.trace_id,
+                        stage="queued", tokens_emitted=0,
+                    )
+                self._finish(req, "cancelled")
+                return True
+        return False
+
     # -- fault disposition ---------------------------------------------------
     def _finish(self, req: ServeRequest, status: str, *, error: Optional[str] = None) -> None:
         req.status = status
@@ -444,6 +495,8 @@ class ServingEngine:
             self.registry.inc("serving_requests_completed_total")
         elif status == "timed_out":
             self.registry.inc("serving_requests_timed_out_total")
+        elif status == "cancelled":
+            self.registry.inc("serving_requests_cancelled_total")
         elif status == "failed":
             self.registry.inc("serving_requests_failed_total")
         now = self._clock()
@@ -638,6 +691,17 @@ class ServingEngine:
         itl_ms = execute_ms / max(1, cfg.max_new_tokens)
         for i, req in enumerate(picked):
             req.result = out[i]
+            if req.on_token is not None:
+                # batch-granular streaming: the whole row materialized at
+                # the fence above, so the sink gets every real token now —
+                # the row up to and including the first EOS (pad after EOS
+                # is filler, never a generated token)
+                toks = out[i].tolist()
+                eos = cfg.eos_token_id
+                if eos is not None and eos in toks:
+                    toks = toks[: toks.index(eos) + 1]
+                for idx, t in enumerate(toks):
+                    self._emit_token(req, idx, int(t))
             ttft_ms = (done_at - req.ttft_from_s) * 1e3
             self._observe_token_latency("serving_ttft_ms", ttft_ms)
             self._observe_token_latency("serving_inter_token_ms", itl_ms)
@@ -788,4 +852,5 @@ class ServingEngine:
             "shed": int(reg.counter("serving_requests_shed_total")),
             "timed_out": int(reg.counter("serving_requests_timed_out_total")),
             "failed": int(reg.counter("serving_requests_failed_total")),
+            "cancelled": int(reg.counter("serving_requests_cancelled_total")),
         }

@@ -94,6 +94,7 @@ FLEET_COUNTERS = (
     "fleet_requests_timed_out_total",
     "fleet_requests_failed_total",
     "fleet_requests_rejected_total",
+    "fleet_requests_cancelled_total",
     "fleet_dispatch_total",
     "fleet_failover_total",
     "fleet_redispatch_total",
@@ -131,6 +132,15 @@ class FleetRequest:
     #: when any other replica is available, so a retry never bounces
     #: straight back onto the executor that just failed it
     last_replica_id: Optional[int] = None
+    #: TTFT anchor handed through to the engine at dispatch (the HTTP
+    #: gateway passes its socket-accept instant); defaults to the fleet's
+    #: own ``submitted_at`` — see ``serving.engine.ServeRequest``
+    ttft_anchor_s: Optional[float] = None
+    #: per-request incremental token sink, forwarded to the engine copy at
+    #: every dispatch (failover replays re-fire indices from 0; greedy
+    #: determinism makes the replayed prefix identical, so stream
+    #: consumers dedupe by index — docs/serving.md "Streaming")
+    on_token: Optional[Callable[[int, int], None]] = None
 
     @property
     def done(self) -> bool:
@@ -454,7 +464,10 @@ class FleetRouter:
 
     # -- queue front --------------------------------------------------------
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
-               *, deadline_s: Optional[float] = None) -> FleetRequest:
+               *, deadline_s: Optional[float] = None,
+               ttft_anchor_s: Optional[float] = None,
+               on_token: Optional[Callable[[int, int], None]] = None
+               ) -> FleetRequest:
         """Enqueue one prompt fleet-wide; returns its durable handle.
 
         Mirrors the engine contract: ``ValueError`` for prompts no replica
@@ -465,6 +478,8 @@ class FleetRouter:
         While the SLO monitor reports a sustained burn, the effective
         ``max_pending`` and default deadline are tightened by
         ``slo_shed_factor`` (:meth:`_effective_admission`).
+        ``ttft_anchor_s`` / ``on_token`` are handed to the engine copy at
+        every dispatch (:class:`FleetRequest`).
         """
         if not self._accepting:
             raise RuntimeError("fleet is draining; new submissions rejected")
@@ -507,6 +522,8 @@ class FleetRouter:
             self._next_id, prompt, config, now,
             deadline_at=None if deadline_s is None else now + deadline_s,
             trace_id=self.tracer.new_trace_id() if self.tracer else None,
+            ttft_anchor_s=ttft_anchor_s,
+            on_token=on_token,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -534,6 +551,38 @@ class FleetRouter:
         retire on their own while other work drives steps, or vanish with
         the next restart."""
         return bool(self._queue) or bool(self._dispatched)
+
+    def cancel(self, request_id: int) -> bool:
+        """Withdraw one fleet request — the gateway's client-disconnect
+        route, lifted to the fleet: a queued request leaves the queue; a
+        dispatched request's LIVE engine copy is cancelled on its replica
+        (the slot engine frees the slot and returns its pool pages
+        immediately), and the fleet request finalizes ``cancelled``
+        exactly once (``fleet_requests_cancelled_total``, one terminal
+        ``fleet.request`` span). Stale copies on hung replicas retire on
+        their own and fall into the ordinary duplicate-dedupe accounting.
+        Returns True when the request was found live."""
+        req = self._inflight.get(request_id)
+        if req is None or req.done:
+            return False
+        if req.status == "dispatched" and req.replica_id is not None:
+            replica = self._replicas[req.replica_id]
+            handle = replica.handles.get(req.request_id)
+            if handle is not None and handle.done:
+                # the engine copy already finished; the next collect sweep
+                # finalizes the fleet request with its REAL disposition — a
+                # finished generation must not be recast as a cancellation
+                # (the single-engine cancel() handles this race the same
+                # way: found-but-done returns False)
+                return False
+            replica.handles.pop(req.request_id, None)
+            if handle is not None:
+                try:
+                    replica.engine.cancel(handle.request_id)
+                except Exception:
+                    pass  # a wedged replica must not block the withdrawal
+        self._finalize(req, "cancelled", replica_id=req.replica_id)
+        return True
 
     def run_until_idle(self) -> int:
         served = 0
@@ -624,6 +673,8 @@ class FleetRouter:
                 )
         elif status == "timed_out":
             self.registry.inc("fleet_requests_timed_out_total")
+        elif status == "cancelled":
+            self.registry.inc("fleet_requests_cancelled_total")
         elif status == "failed":
             self.registry.inc("fleet_requests_failed_total")
         latency_s = self._clock() - req.submitted_at
@@ -808,11 +859,16 @@ class FleetRouter:
                     continue
             try:
                 # ttft_anchor_s: TTFT is user-facing — measured from the
-                # FLEET front door, so fleet queue wait (and failover
+                # FLEET front door (or further back, at the gateway's
+                # socket accept), so fleet queue wait (and failover
                 # replays) stay inside the number the SLO judges
                 handle = replica.engine.submit(
                     req.prompt, req.config, deadline_s=remaining,
-                    ttft_anchor_s=req.submitted_at,
+                    ttft_anchor_s=(
+                        req.submitted_at if req.ttft_anchor_s is None
+                        else req.ttft_anchor_s
+                    ),
+                    on_token=req.on_token,
                 )
             except QueueFull:
                 self._queue.append(req)  # engine backpressure: wait, not a fault
@@ -1091,6 +1147,7 @@ class FleetRouter:
             "timed_out": c("fleet_requests_timed_out_total"),
             "failed": c("fleet_requests_failed_total"),
             "rejected": c("fleet_requests_rejected_total"),
+            "cancelled": c("fleet_requests_cancelled_total"),
             "queued": len(self._queue),
             "dispatched": len(self._dispatched),
             "dispatches": c("fleet_dispatch_total"),
@@ -1161,6 +1218,7 @@ class FleetRouter:
             "shed": int(reg.counter("fleet_requests_shed_total")),
             "timed_out": int(reg.counter("fleet_requests_timed_out_total")),
             "failed": int(reg.counter("fleet_requests_failed_total")),
+            "cancelled": int(reg.counter("fleet_requests_cancelled_total")),
             "replicas_healthy": healthy,
             "replicas": [r.health() for r in self._replicas],
         }

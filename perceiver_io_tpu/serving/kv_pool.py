@@ -92,6 +92,12 @@ class KVPagePool:
         self.high_water = 0
         self.allocs_total = 0
         self.frees_total = 0
+        #: blocks freed per retirement route (``retire`` = ordinary EOS /
+        #: max_new / deadline, ``cancelled`` = client-driven reclaim through
+        #: the gateway's disconnect path, ``failover`` = engine fault) — the
+        #: accounting that makes abandoned-resident leaks visible instead
+        #: of folded into ordinary churn (docs/serving.md "Streaming")
+        self.frees_by_cause: Dict[str, int] = {}
 
     # -- sizing -------------------------------------------------------------
     def blocks_needed(self, tokens: int) -> int:
@@ -166,15 +172,19 @@ class KVPagePool:
             self.high_water = max(self.high_water, self.in_use)
         return changed
 
-    def release(self, slot: int) -> int:
+    def release(self, slot: int, cause: str = "retire") -> int:
         """Free ``slot``'s mapped blocks and drop its unconsumed
-        reservation (retire/failover/timeout all route here); returns the
-        number of blocks physically freed."""
+        reservation (retire/cancel/failover/timeout all route here);
+        returns the number of blocks physically freed. ``cause`` feeds
+        :attr:`frees_by_cause` so cancellation reclaims stay separable
+        from ordinary retirement churn."""
         mapped = self._mapped[slot]
         freed = len(mapped)
         for block in mapped:
             heapq.heappush(self._free, block)
         self.frees_total += freed
+        if freed:
+            self.frees_by_cause[cause] = self.frees_by_cause.get(cause, 0) + freed
         mapped.clear()
         self._reserved[slot] = 0
         self._table[slot, :] = 0
@@ -182,7 +192,7 @@ class KVPagePool:
 
     def release_all(self) -> int:
         """Failover path: every slot's pages back to the free list."""
-        return sum(self.release(s) for s in range(self.slots))
+        return sum(self.release(s, cause="failover") for s in range(self.slots))
 
     # -- views --------------------------------------------------------------
     def table(self):
@@ -216,5 +226,6 @@ class KVPagePool:
             "high_water": self.high_water,
             "allocs_total": self.allocs_total,
             "frees_total": self.frees_total,
+            "frees_by_cause": dict(sorted(self.frees_by_cause.items())),
             "utilization": round(self.utilization(), 4),
         }

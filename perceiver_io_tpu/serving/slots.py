@@ -758,11 +758,14 @@ class SlotServingEngine(ServingEngine):
         (slots, pages) int32 transfer — tiny next to a decode step."""
         self._table_dev = jnp.asarray(self._pool.table())
 
-    def _kv_release(self, slot: int) -> None:
+    def _kv_release(self, slot: int, cause: str = "retire") -> None:
         """Return a retired/failed slot's pages to the pool and refresh
-        gauges + device table."""
+        gauges + device table. ``cause`` tags the pool's free accounting
+        (``frees_by_cause`` in :meth:`KVPagePool.stats`): ordinary
+        retirement vs a client-driven ``cancelled`` reclaim — the long-tail
+        HBM-leak class the gateway's disconnect path exists to close."""
         if self._pool is not None:
-            if self._pool.release(slot):
+            if self._pool.release(slot, cause=cause):
                 self._push_table()
             self._update_kv_gauges()
 
@@ -1148,7 +1151,11 @@ class SlotServingEngine(ServingEngine):
             entry.req.result = out
         self._finish(entry.req, status, error=error)
         self._slots[entry.slot] = None
-        self._kv_release(entry.slot)
+        # pool free-cause taxonomy (kv_pool.frees_by_cause): client-driven
+        # reclaim and engine-fault reclaim stay separable from ordinary
+        # EOS/max_new/deadline churn
+        cause = {"cancelled": "cancelled", "failed": "failover"}.get(status, "retire")
+        self._kv_release(entry.slot, cause=cause)
         if self.tracer is not None:
             self.tracer.event(
                 "serving.slot_retired", trace_id=entry.req.trace_id,
@@ -1175,6 +1182,52 @@ class SlotServingEngine(ServingEngine):
         )
         self._update_slot_gauges()
         return failed
+
+    # -- cancellation --------------------------------------------------------
+    def cancel(self, request_id: int) -> bool:
+        """Token-granular cancellation — the gateway's client-disconnect
+        retirement route (docs/serving.md "Streaming"). Works at every
+        stage of the request lifecycle and reclaims capacity IMMEDIATELY
+        (within the current scheduling instant, i.e. before the next
+        ``step()`` runs — the zero-leak bar the chaos drill pins):
+
+        - **resident** — the slot retires ``cancelled`` right now: the slot
+          frees for the next queued admission and, under the paged layout,
+          every pool page (mapped + reserved) returns to the
+          :class:`~perceiver_io_tpu.serving.kv_pool.KVPagePool` tagged
+          ``cancelled``. Surviving residents are untouched — per-row
+          independence means their token streams cannot shift (pinned).
+        - **mid chunked admission** — the in-flight admission is dropped
+          before its row ever enters the slot state; staging caches are
+          garbage-by-construction and the reserved pages return.
+        - **queued** — base-class behavior (leaves the queue).
+
+        Exactly one terminal ``serving.request`` span (status
+        ``cancelled``) plus one ``serving.cancelled`` event end the trace.
+        Returns True when the request was found live."""
+        admit = self._admitting
+        if admit is not None and admit.req.request_id == request_id:
+            self._admitting = None
+            self._kv_release(admit.slot, cause="cancelled")
+            if self.tracer is not None:
+                self.tracer.event(
+                    "serving.cancelled", trace_id=admit.req.trace_id,
+                    stage="admitting", slot=admit.slot, tokens_emitted=0,
+                )
+            self._finish(admit.req, "cancelled")
+            return True
+        for entry in self._active():
+            if entry.req.request_id == request_id:
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "serving.cancelled", trace_id=entry.req.trace_id,
+                        stage="resident", slot=entry.slot,
+                        tokens_emitted=len(entry.emitted),
+                    )
+                self._retire(entry, "cancelled")
+                self._update_slot_gauges()
+                return True
+        return super().cancel(request_id)
 
     # -- the token-level scheduler ------------------------------------------
     def step(self) -> int:
@@ -1351,6 +1404,11 @@ class SlotServingEngine(ServingEngine):
             token = int(tokens[entry.slot])
             first = not entry.emitted
             entry.emitted.append(token)
+            if entry.req.on_token is not None:
+                # incremental streaming: the fence above materialized this
+                # token, so the sink (the gateway's per-stream queue) gets
+                # it the same instant the scheduler does
+                self._emit_token(entry.req, len(entry.emitted) - 1, token)
             entry.m = min(entry.m + 1, self.model.max_latents)
             if first:
                 ttft_ms = (token_at - entry.req.ttft_from_s) * 1e3

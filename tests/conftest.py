@@ -71,6 +71,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "gateway: HTTP/SSE streaming-gateway test (per-token streaming over "
+        "real sockets, client-disconnect cancellation, socket-anchored TTFT; "
+        "serving/gateway.py, docs/serving.md); CPU-fast, runs in the tier-1 "
+        "suite with a tight per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )

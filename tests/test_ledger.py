@@ -522,7 +522,8 @@ def test_cli_obs_report_subcommand(capsys):
         "obs", "report", "--events=tests/fixtures/events.jsonl",
         "--json=true",
     ])
-    assert json.loads(as_json)["requests"]["terminal_spans"] == 4
+    # 4 ok + 1 cancelled (the gateway-era fixture extension)
+    assert json.loads(as_json)["requests"]["terminal_spans"] == 5
     with pytest.raises(SystemExit, match="requires --events"):
         clm_script.main(["obs", "report"])
     with pytest.raises(SystemExit, match="usage: obs report"):

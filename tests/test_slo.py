@@ -698,15 +698,18 @@ def test_report_slo_section_pinned_over_fixtures():
     assert slo["burn_rates"]["slo_burn_rate_ttft_slow"] == 4.0
     assert [t["event"] for t in slo["timeline"]] == ["slo.breach", "slo.recover"]
     assert slo["timeline"][0]["dimension"] == "ttft"
+    # offered includes the gateway fixture's cancelled request (5 accepted,
+    # 4 completed) — a client-abandoned request is offered load that did
+    # not complete, so it stays in the denominator
     assert slo["goodput"] == {
-        "prefix": "serving", "offered": 4, "completed": 4, "ratio": 1.0,
+        "prefix": "serving", "offered": 5, "completed": 4, "ratio": 0.8,
     }
     text = report_mod.run(
         "tests/fixtures/events.jsonl", "tests/fixtures/metrics_snapshot.json"
     )
     assert "== slo ==" in text
     assert "breaches=1  recoveries=1" in text
-    assert "goodput (serving): 4/4 offered = 1.0" in text
+    assert "goodput (serving): 4/5 offered = 0.8" in text
     assert "slo.breach" in text and "dim=ttft" in text
 
 
