@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos obs obs-report slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos obs obs-report slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench prefix-cache prefix-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -123,6 +123,30 @@ paged-bench:
 	model = CausalLanguageModel(cfg); \
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'paged_kv': bench._bench_paged_kv(model, params, cfg)}, indent=2))"
+
+# cross-request prefix-sharing suite (docs/serving.md "Prefix sharing"):
+# COW/refcount allocator drills, radix-index units, greedy token-identity
+# across hot/partial/divergent/chunked/cancel/failover geometries, LRU
+# eviction under pool pressure — CPU-fast, also tier-1, per-test timeout
+# budget via the conftest SIGALRM guard
+prefix-cache:
+	$(PY) -m pytest tests/ -q -m prefix_cache --continue-on-collection-errors
+
+# prefix-sharing A/B at the CPU-fallback shape (docs/serving.md "Prefix
+# sharing"): Zipf-distributed shared prefixes through the paged slot
+# engine, unshared vs COW-shared at ONE simulated HBM budget — TTFT
+# p50/p95 ratio, residents-per-HBM-byte, hit ratio, token identity
+prefix-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'prefix_cache': bench._bench_prefix_cache(model, params, cfg)}, indent=2))"
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -556,6 +556,21 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "paged_kv": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: prefix-cache A/B (Zipf shared prefixes, COW sharing) ----
+        if left() > 150.0:
+            log("run: prefix-cache A/B (Zipf shared prefixes, unshared vs COW-shared)")
+            try:
+                pfx = _bench_prefix_cache(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "prefix_cache": pfx})
+                log(f"run: prefix-cache TTFT p95 ratio {pfx['ttft_p95_ratio']}x, "
+                    f"residents/byte ratio {pfx['residents_per_hbm_byte_ratio']}x, "
+                    f"hit_ratio={pfx['hit_ratio']}, token_identical="
+                    f"{pfx['token_identical']}")
+            except Exception as e:
+                log(f"run: prefix-cache A/B failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "prefix_cache": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: chaos drill (fault-injected serving, deterministic) ----
         if left() > 60.0:
             log("run: chaos probe (backpressure / deadlines / fault isolation)")
@@ -1163,6 +1178,184 @@ def _bench_paged_kv(model, params, cfg, *, dense_slots: int = 4,
         "paged_vs_dense_tokens_ratio": round(
             (useful_tokens / paged_dt) / (useful_tokens / dense_dt), 2
         ),
+        "token_identical": token_identical,
+    }
+
+
+def _bench_prefix_cache(model, params, cfg, *, slots: int = 8,
+                        n_requests: int = 24, n_prefixes: int = 2,
+                        block_size: int = None, prefix_tokens: int = None,
+                        budget_blocks: int = None, new_tokens: int = 4,
+                        zipf: float = 2.5):
+    """Prefix-sharing A/B (ISSUE 12 acceptance; docs/serving.md "Prefix
+    sharing"): a Zipf-distributed shared-prefix workload — the
+    :class:`~perceiver_io_tpu.observability.WorkloadSpec` shared-prefix
+    distribution, a pool of ``n_prefixes`` long "system prompts" sampled
+    by Zipf popularity with short fresh tails — served through the paged
+    slot engine twice at ONE simulated HBM budget: ``prefix_cache="off"``
+    (every admit re-projects its full prompt and reserves private pages)
+    vs ``"on"`` (hot prefixes map by reference, prefill projects only the
+    suffix). Recorded acceptance numbers: the TTFT p50/p95 ratio (the
+    unshared full-window projection + the deeper queue it causes, vs
+    block-table writes + suffix projection), concurrent
+    residents-per-HBM-byte (shared blocks are reserved once, not per
+    resident), the hit ratio, and ``token_identical`` between the two
+    arms' greedy outputs (the exactness bar, also pinned by
+    ``tests/test_prefix_cache.py``).
+
+    Like ``_bench_prefill_chunk_ab``, the probe builds its own model at
+    ``cfg``'s context/width but with a TIGHT latent segment
+    (``max_latents = 2 * num_latents``): admission cost then comes from
+    the prefix positions themselves — the full-window embedding +
+    cross-k/v projection sharing elides — rather than from the
+    latent-segment stack, which every admission pays identically in both
+    arms (at ``max_latents=256`` the stack is most of the prefill and
+    buries the A/B in shared cost)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+    from perceiver_io_tpu.models.text.clm import (
+        CausalLanguageModel,
+        CausalLanguageModelConfig,
+    )
+    from perceiver_io_tpu.observability import MetricsRegistry, WorkloadSpec
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    n = cfg.max_seq_len
+    num_latents = min(4, cfg.max_latents)
+    if cfg.max_latents > 2 * num_latents:
+        probe_cfg = CausalLanguageModelConfig(
+            vocab_size=cfg.vocab_size,
+            max_seq_len=n,
+            max_latents=2 * num_latents,
+            num_channels=cfg.num_channels,
+            num_heads=cfg.num_heads,
+            num_self_attention_layers=cfg.num_self_attention_layers,
+            cross_attention_dropout=0.0,
+        )
+        model = CausalLanguageModel(probe_cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, n), jnp.int32),
+            n - probe_cfg.max_latents,
+        )["params"]
+        cfg = probe_cfg
+    params = cast_float_params(params, jnp.bfloat16)
+    if block_size is None:
+        block_size = max(4, min(16, n // 32))
+    if prefix_tokens is None:
+        # long hot prefix, well past the latent budget, bounded by the
+        # prefix-capacity scope check
+        prefix_tokens = max(
+            block_size * 2,
+            min(n // 4, model.max_prefix_len - 32, 384) // block_size * block_size,
+        )
+    tail_lo, tail_hi = 8, 16
+    bucket = prefix_tokens + tail_hi
+    if bucket + new_tokens > n:
+        raise ValueError("prefix-cache probe shape exceeds the context")
+    table = BucketTable(prompt_lens=(bucket,), batch_sizes=(1,))
+    gcfg = GenerationConfig(
+        max_new_tokens=new_tokens, num_latents=num_latents,
+        sampling=SamplingConfig(temperature=0.0),  # greedy: cross-arm identity
+    )
+    workload = WorkloadSpec(
+        prompt_len=(tail_lo, tail_hi),
+        max_new_tokens=(new_tokens, new_tokens),
+        vocab=(1, cfg.vocab_size),
+        shared_prefix_pool=n_prefixes,
+        shared_prefix_len=(prefix_tokens, prefix_tokens),
+        shared_prefix_zipf=zipf,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [workload.sample_prompt(rng) for _ in range(n_requests)]
+    per_req_blocks = -(-(prefix_tokens + tail_hi + new_tokens) // block_size)
+    if budget_blocks is None:
+        # fits ~3 unshared residents: the unshared arm serializes on the
+        # pool while the shared arm — whose residents reserve only their
+        # private suffix pages — packs the cached prefixes plus a full
+        # house of slots into the same bytes
+        budget_blocks = per_req_blocks * 7 // 2
+    token_bytes = None
+
+    def run(pc):
+        nonlocal token_bytes
+        registry = MetricsRegistry()
+        engine = SlotServingEngine(
+            model, params, gcfg, table, slots=slots, kv_layout="paged",
+            kv_block_size=block_size, kv_blocks=budget_blocks,
+            prefix_cache=pc, registry=registry,
+        )
+        engine.warmup()  # compiles are process-global: measured once
+        token_bytes = engine._kv_token_bytes
+        handles = [engine.submit(p, config=gcfg) for p in prompts]
+        max_residents = 0
+        t0 = time.perf_counter()
+        while engine.pending():
+            engine.step()
+            active = sum(1 for s in engine._slots if s is not None)
+            if engine._admitting is not None:
+                active += 1
+            max_residents = max(max_residents, active)
+        dt = time.perf_counter() - t0
+        stats = engine.stats()
+        assert engine._pool.leaked() == 0
+        return {
+            "outs": [h.result for h in handles],
+            "ttft_p50_ms": registry.percentile("serving_ttft_ms", 50.0),
+            "ttft_p95_ms": registry.percentile("serving_ttft_ms", 95.0),
+            "max_residents": max_residents,
+            "tokens_per_sec": round(n_requests * new_tokens / dt, 1),
+            "admit_waits": stats["kv_pool"]["admit_waits"],
+            "prefix": stats["prefix_cache"],
+        }
+
+    off = run("off")
+    on = run("on")
+    token_identical = all(
+        a is not None and b is not None and bool(np.array_equal(a, b))
+        for a, b in zip(off["outs"], on["outs"])
+    )
+    budget_bytes = budget_blocks * block_size * token_bytes
+
+    def arm(r):
+        return {
+            "ttft_p50_ms": None if r["ttft_p50_ms"] is None else round(r["ttft_p50_ms"], 3),
+            "ttft_p95_ms": None if r["ttft_p95_ms"] is None else round(r["ttft_p95_ms"], 3),
+            "max_residents": r["max_residents"],
+            "residents_per_hbm_gb": round(r["max_residents"] / (budget_bytes / 2**30), 2),
+            "tokens_per_sec": r["tokens_per_sec"],
+            "admit_waits": r["admit_waits"],
+        }
+
+    return {
+        "workload": {
+            "requests": n_requests,
+            "prefixes": n_prefixes,
+            "zipf": zipf,
+            "prefix_tokens": prefix_tokens,
+            "tail_tokens": [tail_lo, tail_hi],
+            "block_size": block_size,
+            "hbm_budget_blocks": budget_blocks,
+            "hbm_budget_bytes": budget_bytes,
+        },
+        "unshared": arm(off),
+        "shared": {**arm(on), "prefix": on["prefix"]},
+        "ttft_p50_ratio": round(
+            (off["ttft_p50_ms"] or 0.0) / max(1e-9, on["ttft_p50_ms"] or 0.0), 2
+        ),
+        "ttft_p95_ratio": round(
+            (off["ttft_p95_ms"] or 0.0) / max(1e-9, on["ttft_p95_ms"] or 0.0), 2
+        ),
+        "residents_per_hbm_byte_ratio": round(
+            on["max_residents"] / max(1, off["max_residents"]), 2
+        ),
+        "hit_ratio": on["prefix"]["hit_ratio"],
         "token_identical": token_identical,
     }
 
@@ -1790,10 +1983,18 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
     )
     table = BucketTable(prompt_lens=(max_len,), batch_sizes=(1,))
     gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    # shared-prefix workload (docs/serving.md "Prefix sharing"): a small
+    # pool of fixed system prompts + fresh tails, so the sweep exercises
+    # the prefix cache end to end — in-process AND over the HTTP
+    # transport. Block size divides the prefix so hot admissions share.
+    prefix_tokens = max(num_latents, max_len // 2)
+    kv_block = max(2, prefix_tokens // 2)
     workload = WorkloadSpec(
-        prompt_len=(max(num_latents, max_len // 2), max_len),
+        prompt_len=(2, max_len - prefix_tokens),
         max_new_tokens=(max(2, new_tokens // 2), new_tokens),
         vocab=(1, cfg.vocab_size),
+        shared_prefix_pool=3,
+        shared_prefix_len=(prefix_tokens, prefix_tokens),
     )
 
     def run_point(rate_rps, mode, seed):
@@ -1801,6 +2002,7 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
         tracer = Tracer()
         engine = SlotServingEngine(
             model, params, gcfg, table, slots=slots,
+            kv_layout="paged", kv_block_size=kv_block, prefix_cache="on",
             registry=registry, tracer=tracer, rng=jax.random.PRNGKey(2),
         )
         gateway = None
@@ -1826,7 +2028,10 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
 
     # warm every executor once up front — the sweep measures serving, not
     # compiles (caches are process-global, so later engines reuse them)
-    SlotServingEngine(model, params, gcfg, table, slots=slots).warmup()
+    SlotServingEngine(
+        model, params, gcfg, table, slots=slots,
+        kv_layout="paged", kv_block_size=kv_block, prefix_cache="on",
+    ).warmup()
 
     # calibration: closed loop at full slot concurrency = capacity estimate
     reg_c, _, _, rep_c = run_point(1.0, "closed", seed=0)
@@ -1886,6 +2091,13 @@ def _bench_slo_goodput(model, params, cfg, *, requests_per_rate: int = 10,
             "goodput_rps": round(good / rep["span_s"], 4),
             "goodput_ratio": round(goodput_ratio(registry.counters()), 4),
             "bytes_on_wire": rep.get("bytes_on_wire"),
+            # shared-prefix workload: sharing is live through this point
+            # (in-process or over the HTTP transport alike)
+            "prefix_hit_ratio": round(
+                registry.counter("kv_prefix_hits_total")
+                / max(1, registry.counter("kv_prefix_hits_total")
+                      + registry.counter("kv_prefix_misses_total")), 4
+            ),
         })
     knee_idx = max(
         range(len(sweep)), key=lambda i: (sweep[i]["goodput_rps"], -i)

@@ -57,10 +57,20 @@ PHASE_CHOICES = ("cached", "recompute")
 KV_LAYOUTS = ("auto", "dense", "paged")
 KV_LAYOUT_CHOICES = ("dense", "paged")
 
+#: slot-engine cross-request prefix-cache axis (docs/serving.md "Prefix
+#: sharing"): whether paged admissions map hot prompt-prefix blocks by
+#: reference instead of re-projecting them. Like the layouts it is a
+#: deployment property (traffic skew decides whether the radix index pays
+#: its bookkeeping), so it rides in the same persisted registry.
+PREFIX_CACHE_MODES = ("auto", "on", "off")
+PREFIX_CACHE_CHOICES = ("on", "off")
+
 #: env var overriding the boundary-phase strategy process-wide
 ENV_VAR = "PERCEIVER_DECODE_STRATEGY"
 #: env var overriding the slot engine's KV layout process-wide
 ENV_KV_LAYOUT = "PERCEIVER_KV_LAYOUT"
+#: env var overriding the slot engine's prefix-cache mode process-wide
+ENV_PREFIX_CACHE = "PERCEIVER_PREFIX_CACHE"
 #: env var pointing at a persisted strategy-registry JSON file
 ENV_FILE = "PERCEIVER_DECODE_STRATEGY_FILE"
 
@@ -103,6 +113,8 @@ _REGISTRY: dict = {}
 #: (separate dict so a boundary-only artifact and a kv-only artifact can
 #: merge without clobbering each other)
 _KV_REGISTRY: dict = {}
+#: same key space -> {"prefix_cache": "on"|"off", ...} measurement entry
+_PREFIX_REGISTRY: dict = {}
 _FILE_LOADED: set = set()  # paths already merged into the registries
 
 
@@ -176,10 +188,61 @@ def record_kv_layout(model, kv_layout: str, *, platform: Optional[str] = None,
     return entry
 
 
+def lookup_prefix_cache(model, platform: Optional[str] = None) -> Optional[str]:
+    """Recorded prefix-cache verdict for this shape/platform/env, or None."""
+    _maybe_load_env_file()
+    entry = _PREFIX_REGISTRY.get(registry_key(model, platform))
+    return None if entry is None else entry["prefix_cache"]
+
+
+def record_prefix_cache(model, prefix_cache: str, *,
+                        platform: Optional[str] = None, **extra) -> dict:
+    """Store a prefix-cache verdict (plus metadata — e.g. the measured hit
+    ratio a deployment observed) for this shape/platform/env."""
+    if prefix_cache not in PREFIX_CACHE_CHOICES:
+        raise ValueError(
+            f"prefix_cache must be one of {PREFIX_CACHE_CHOICES}, "
+            f"got {prefix_cache!r}"
+        )
+    entry = {"prefix_cache": prefix_cache, **extra}
+    _PREFIX_REGISTRY[registry_key(model, platform)] = entry
+    return entry
+
+
+def resolve_prefix_cache(
+    mode: Optional[str],
+    model=None,
+    *,
+    platform: Optional[str] = None,
+) -> str:
+    """Resolve a slot-engine prefix-cache request into ``"on"`` or
+    ``"off"`` (docs/serving.md "Prefix sharing").
+
+    Order mirrors :func:`resolve_kv_layout`: explicit mode >
+    :data:`ENV_PREFIX_CACHE` > ``"auto"`` (registry lookup, falling back
+    to ``"off"`` — the status-quo unshared path — when nothing has been
+    recorded). Sharing only exists under ``kv_layout="paged"``; the
+    engine enforces that pairing, not this resolver.
+    """
+    if mode is None:
+        mode = os.environ.get(ENV_PREFIX_CACHE) or "auto"
+    if mode not in PREFIX_CACHE_MODES:
+        raise ValueError(
+            f"prefix cache must be one of {PREFIX_CACHE_MODES}, got {mode!r}"
+        )
+    if mode == "auto":
+        measured = (
+            lookup_prefix_cache(model, platform) if model is not None else None
+        )
+        return measured or "off"
+    return mode
+
+
 def reset_registry() -> None:
     """Test isolation: drop every memoized verdict and forget loaded files."""
     _REGISTRY.clear()
     _KV_REGISTRY.clear()
+    _PREFIX_REGISTRY.clear()
     _FILE_LOADED.clear()
 
 
@@ -210,15 +273,21 @@ def save_registry(path: str) -> None:
             _KV_REGISTRY.items(), key=lambda kv: repr(kv[0])
         )
     ]
+    prefix_entries = [
+        {"key": _key_to_json(key), **entry} for key, entry in sorted(
+            _PREFIX_REGISTRY.items(), key=lambda kv: repr(kv[0])
+        )
+    ]
     tmp = path + ".tmp"
     dirpath = os.path.dirname(path)
     if dirpath:
         os.makedirs(dirpath, exist_ok=True)
     with open(tmp, "w") as fh:
-        # version stays 1: kv_entries is additive and readers written
-        # before it simply ignore the key
+        # version stays 1: kv_entries / prefix_entries are additive and
+        # readers written before them simply ignore the keys
         json.dump(
-            {"version": 1, "entries": entries, "kv_entries": kv_entries},
+            {"version": 1, "entries": entries, "kv_entries": kv_entries,
+             "prefix_entries": prefix_entries},
             fh, indent=2,
         )
     os.replace(tmp, path)
@@ -241,6 +310,7 @@ def load_registry(path: str) -> int:
     for field, dest, value_key, choices in (
         ("entries", _REGISTRY, "boundary", PHASE_CHOICES),
         ("kv_entries", _KV_REGISTRY, "kv_layout", KV_LAYOUT_CHOICES),
+        ("prefix_entries", _PREFIX_REGISTRY, "prefix_cache", PREFIX_CACHE_CHOICES),
     ):
         entries = data.get(field)
         if not isinstance(entries, list):

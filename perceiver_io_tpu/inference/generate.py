@@ -145,6 +145,32 @@ def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     return logits
 
 
+def _latent_stack_capture(ar, x, stack_pad, rot_latent, seg_idx):
+    """Self-attention stack over the latent segment with per-layer k/v
+    capture at the ``m`` real latents' segment slots (rotary on layer 0
+    only, mirroring the stack's first-layer-rotary semantics) — ONE
+    implementation shared by the one-shot prefill and both finalize paths
+    (dense chunked, paged shared-prefix), so the admission paths cannot
+    drift bitwise: same masks, same capture indices.
+
+    :return: ``(x, stack_k, stack_v)`` — the stack output and the per-layer
+        captured caches.
+    """
+    stack_k, stack_v = [], []
+    for i, sa_layer in enumerate(ar.self_attention.layers):
+        sa = sa_layer.self_attn
+        r = rot_latent if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        stack_k.append(jnp.take_along_axis(k_s, seg_idx[None, None, :, None], axis=2))
+        stack_v.append(jnp.take_along_axis(v_s, seg_idx[None, None, :, None], axis=2))
+        attn = sa.attention.attend(q_s, k_s, v_s, pad_mask=stack_pad, deterministic=True)
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+    return x, stack_k, stack_v
+
+
 def _decode_prefill(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.ndarray):
     """Forward over the right-aligned window that additionally builds the
     decode caches for the latent-growth phase.
@@ -202,18 +228,7 @@ def _decode_prefill(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     frq_latent = frq[:, -num_latents:]
     rot_latent = RotaryEmbedding(frq_latent, right_align=True)
     seg_idx = jnp.clip(num_latents - m + jnp.arange(num_latents), 0, num_latents - 1)
-    stack_k, stack_v = [], []
-    for i, sa_layer in enumerate(ar.self_attention.layers):
-        sa = sa_layer.self_attn
-        r = rot_latent if (i == 0 or ar.self_attention.rotary_all_layers) else None
-        normed = sa.norm(x)
-        q_s = sa.attention.project_q(normed, r)
-        k_s, v_s = sa.attention.project_kv(normed, r)
-        stack_k.append(jnp.take_along_axis(k_s, seg_idx[None, None, :, None], axis=2))
-        stack_v.append(jnp.take_along_axis(v_s, seg_idx[None, None, :, None], axis=2))
-        attn = sa.attention.attend(q_s, k_s, v_s, pad_mask=stack_pad, deterministic=True)
-        x = attn + x
-        x = sa_layer.mlp(x) + x
+    x, stack_k, stack_v = _latent_stack_capture(ar, x, stack_pad, rot_latent, seg_idx)
 
     x_last = x[:, -1]
     if mdl.config.output_norm:
@@ -306,24 +321,13 @@ def _prefill_finalize(mdl, window: jnp.ndarray, pad_count: jnp.ndarray,
     x = layer.mlp(x) + x
 
     # Self-attention stack with per-layer cache capture (_decode_prefill's
-    # loop verbatim: same masks, same first-layer-rotary semantics).
+    # shared helper: same masks, same first-layer-rotary semantics).
     stack_pad = jnp.broadcast_to(
         jnp.arange(num_latents)[None, :] < num_latents - m, (b, num_latents)
     )
     rot_latent = RotaryEmbedding(frq_lat, right_align=True)
     seg_idx = jnp.clip(num_latents - m + jnp.arange(num_latents), 0, num_latents - 1)
-    stack_k, stack_v = [], []
-    for i, sa_layer in enumerate(ar.self_attention.layers):
-        sa = sa_layer.self_attn
-        r = rot_latent if (i == 0 or ar.self_attention.rotary_all_layers) else None
-        normed = sa.norm(x)
-        q_s = sa.attention.project_q(normed, r)
-        k_s, v_s = sa.attention.project_kv(normed, r)
-        stack_k.append(jnp.take_along_axis(k_s, seg_idx[None, None, :, None], axis=2))
-        stack_v.append(jnp.take_along_axis(v_s, seg_idx[None, None, :, None], axis=2))
-        attn = sa.attention.attend(q_s, k_s, v_s, pad_mask=stack_pad, deterministic=True)
-        x = attn + x
-        x = sa_layer.mlp(x) + x
+    x, stack_k, stack_v = _latent_stack_capture(ar, x, stack_pad, rot_latent, seg_idx)
 
     x_last = x[:, -1]
     if mdl.config.output_norm:
@@ -333,6 +337,95 @@ def _prefill_finalize(mdl, window: jnp.ndarray, pad_count: jnp.ndarray,
     cache = {"cross_k": cross_k, "cross_v": cross_v,
              "stack_k": stack_k, "stack_v": stack_v}
     return logits, cache, length, m
+
+
+def _prefill_finalize_paged(
+    mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.ndarray,
+    pool_k, pool_v, table_row: jnp.ndarray, block_size: int,
+):
+    """:func:`_prefill_finalize` over the block-paged KV layout with a
+    **suffix-only** contract (docs/serving.md "Prefix sharing"): cross k/v
+    for every prefix position are ALREADY RESIDENT in the pool — shared
+    prefix blocks another request published (never re-projected: the TTFT
+    win prefix sharing exists for) and/or this admission's own staged
+    chunks, which only covered ``[start_position, prefix_len)`` — so this
+    call only projects the ``m`` real latents' ``q_norm``-side k/v,
+    scatters them through the slot's block table, gathers the WHOLE window
+    back from the pool, and runs the attend + self-attention stack exactly
+    as the dense finalize does. A fully-hot prefix stages zero chunks and
+    the admission collapses to block-table writes plus this one call.
+
+    Latent scatter routing: non-real segment slots (prompt shorter than
+    the latent budget) route to the null block — the paged analogue of the
+    dense finalize's ``mode="drop"`` — so staged/shared prefix values
+    survive, and the gather + masked attend is bitwise identical to the
+    dense path (the parity bar ``tests/test_prefix_cache.py`` pins).
+
+    :return: ``(logits, pool_k, pool_v, stack cache, length, m)``.
+    """
+    from perceiver_io_tpu.ops import paged_attention as paged
+
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    table = table_row[None] if table_row.ndim == 1 else table_row
+
+    # Latent segment (last max_latents window slots) at true token indices;
+    # p_seg < 0 marks pad slots (prompt shorter than the latent budget).
+    p_seg = jnp.arange(n - num_latents, n)[None, :] - pad_count[:, None]
+    lat_abs = jnp.maximum(p_seg, 0)
+    emb_lat, frq_lat = ar.input_adapter(window[:, n - num_latents:], abs_pos=lat_abs)
+    x_q_lat = ca.q_norm(emb_lat)
+
+    # q_norm-side k/v of the m real latents, scattered at their abs
+    # indices through the block table; prefix-classified or pad segment
+    # slots route to the null block (their kv_norm-side pool entries came
+    # from chunk passes / shared blocks and must survive).
+    k_lat, v_lat = mha.project_kv(x_q_lat, RotaryEmbedding(frq_lat))
+    is_real = jnp.arange(num_latents)[None, :] >= num_latents - m
+    idx = jnp.clip(p_seg, 0, n - 1)
+    flat_lat = paged.flat_write_indices(table, idx, block_size)
+    flat_lat = jnp.where(is_real, flat_lat, idx % block_size)  # null-route
+    pool_k = pool_k.at[flat_lat[0]].set(
+        k_lat[0].transpose(1, 0, 2).astype(pool_k.dtype)
+    )
+    pool_v = pool_v.at[flat_lat[0]].set(
+        v_lat[0].transpose(1, 0, 2).astype(pool_v.dtype)
+    )
+
+    # Gather into window-slot alignment and attend exactly as the dense
+    # finalize does (pad slots gather position-0 values the pad mask
+    # zeroes out of the softmax — the _decode_step_boundary argument).
+    slot_abs = jnp.maximum(jnp.arange(n)[None, :] - pad_count[:, None], 0)
+    flat_g = paged.flat_write_indices(table, slot_abs, block_size)
+    k_slots = paged.gather_kv(pool_k, flat_g)
+    v_slots = paged.gather_kv(pool_v, flat_g)
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    q = mha.project_q(x_q_lat, RotaryEmbedding(frq_lat, right_align=True))
+    attn = mha.attend(q, k_slots, v_slots, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb_lat
+    x = layer.mlp(x) + x
+
+    # Self-attention stack with per-layer cache capture (the shared
+    # helper: same masks, same first-layer-rotary semantics as the dense
+    # prefill/finalize — the bitwise half of the parity claim).
+    stack_pad = jnp.broadcast_to(
+        jnp.arange(num_latents)[None, :] < num_latents - m, (b, num_latents)
+    )
+    rot_latent = RotaryEmbedding(frq_lat, right_align=True)
+    seg_idx = jnp.clip(num_latents - m + jnp.arange(num_latents), 0, num_latents - 1)
+    x, stack_k, stack_v = _latent_stack_capture(ar, x, stack_pad, rot_latent, seg_idx)
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    length = (n - pad_count).astype(jnp.int32)
+    cache = {"stack_k": stack_k, "stack_v": stack_v}
+    return logits, pool_k, pool_v, cache, length, m
 
 
 def _decode_step(mdl, token: jnp.ndarray, cache: dict, length: jnp.ndarray, m: jnp.ndarray):

@@ -87,6 +87,14 @@ HELP_TEXT = {
     "kv_pool_block_allocs_total": "Pool block map operations (admit, chunk progress, decode page crossings).",
     "kv_pool_block_frees_total": "Pool blocks returned on retire/failure.",
     "kv_pool_admit_waits_total": "Requests that waited at the queue head for pool blocks to free.",
+    "kv_prefix_hits_total": "Paged admissions that mapped at least one cached prefix block by reference.",
+    "kv_prefix_misses_total": "Paged admissions with no usable cached prefix (prefix cache on).",
+    "kv_prefix_shared_blocks_total": "Pool blocks mapped by reference (full + COW'd partial) across hit admissions.",
+    "kv_prefix_shared_tokens_total": "Prompt token positions whose projection was skipped via prefix sharing.",
+    "kv_prefix_cow_copies_total": "Copy-on-write page copies (partial/divergent block at admit, or the decode write guard).",
+    "kv_prefix_evicted_blocks_total": "Cached prefix blocks LRU-dropped from the index under pool pressure.",
+    "kv_prefix_published_blocks_total": "Full prefix blocks published into the prefix index after admission.",
+    "kv_prefix_cached_blocks": "Pool blocks currently retained by the prefix index.",
     "executor_resident_bytes": "Sum of recorded executors' temp+output bytes (XLA memory analysis).",
     "trainer_steps_total": "Executed optimizer steps (skipped steps included).",
     "trainer_skipped_steps_total": "Steps discarded by the non-finite skip policy.",

@@ -72,7 +72,16 @@ MODES = ("open", "closed")
 @dataclasses.dataclass
 class WorkloadSpec:
     """Per-request shape distributions, all sampled from the generator's
-    injected rng. Ranges are inclusive ``(lo, hi)``."""
+    injected rng. Ranges are inclusive ``(lo, hi)``.
+
+    **Shared prefixes** (docs/serving.md "Prefix sharing"): with
+    ``shared_prefix_pool > 0`` every prompt is ``prefix + fresh tail`` —
+    the prefix drawn from a pool of ``shared_prefix_pool`` fixed "system
+    prompts" (materialized once from the SAME injected rng, so the whole
+    workload stays deterministic) sampled by popularity rank from a Zipf
+    law with exponent ``shared_prefix_zipf``, the production skew the
+    prefix cache exists for. ``prompt_len`` then sizes the per-request
+    TAIL, not the whole prompt."""
 
     prompt_len: Tuple[int, int] = (4, 12)
     max_new_tokens: Tuple[int, int] = (4, 8)
@@ -82,11 +91,45 @@ class WorkloadSpec:
     #: closed-loop think time between a completion and the user's next
     #: submission, seconds
     think_time_s: Tuple[float, float] = (0.0, 0.0)
+    #: number of distinct shared prefixes (0 = every prompt fully random)
+    shared_prefix_pool: int = 0
+    #: token length range of each shared prefix (sampled per prefix, once)
+    shared_prefix_len: Tuple[int, int] = (8, 8)
+    #: Zipf popularity exponent (> 1; larger = hotter head)
+    shared_prefix_zipf: float = 1.5
+    #: lazily-materialized prefix pool (drawn from the run's rng on first
+    #: use — not part of the spec's identity)
+    _prefixes: Optional[list] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def _prefix(self, rng: np.random.Generator) -> np.ndarray:
+        if self._prefixes is None:
+            if self.shared_prefix_zipf <= 1.0:
+                raise ValueError(
+                    f"shared_prefix_zipf must be > 1, got {self.shared_prefix_zipf}"
+                )
+            lo, hi = self.shared_prefix_len
+            self._prefixes = [
+                rng.integers(
+                    self.vocab[0], self.vocab[1],
+                    size=int(rng.integers(lo, hi + 1)), dtype=np.int32,
+                )
+                for _ in range(self.shared_prefix_pool)
+            ]
+        # unbounded Zipf rank folded onto the pool: rank 1 (the hottest
+        # system prompt) keeps its Zipf mass, the tail wraps — skew is
+        # preserved and every prefix stays reachable
+        rank = (int(rng.zipf(self.shared_prefix_zipf)) - 1) % self.shared_prefix_pool
+        return self._prefixes[rank]
 
     def sample_prompt(self, rng: np.random.Generator) -> np.ndarray:
         lo, hi = self.prompt_len
         n = int(rng.integers(lo, hi + 1))
-        return rng.integers(self.vocab[0], self.vocab[1], size=n, dtype=np.int32)
+        tail = rng.integers(self.vocab[0], self.vocab[1], size=n, dtype=np.int32)
+        if self.shared_prefix_pool > 0:
+            return np.concatenate([self._prefix(rng), tail])
+        return tail
 
     def sample_max_new(self, rng: np.random.Generator) -> int:
         lo, hi = self.max_new_tokens

@@ -117,7 +117,8 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
                 "attrs": {
                     k: attrs[k] for k in
                     ("slot", "bucket", "prefill_ms", "chunk", "decode_steps",
-                     "size", "execute_ms", "error")
+                     "size", "execute_ms", "error", "shared_tokens",
+                     "shared_blocks", "cow")
                     if k in attrs
                 },
             })
@@ -361,6 +362,26 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
 
     in_use = g("kv_pool_blocks_in_use")
     high = g("kv_pool_blocks_high_water")
+    # prefix-cache rollup (docs/serving.md "Prefix sharing"): hit/miss
+    # ratio, skipped-projection tokens, COW/eviction churn from the
+    # kv_prefix_* families (per-admission serving.prefix_hit events render
+    # in the request waterfall). None when the run never enabled sharing —
+    # pre-prefix artifacts stay unchanged.
+    prefix = None
+    hits = c("kv_prefix_hits_total")
+    misses = c("kv_prefix_misses_total")
+    if hits is not None or misses is not None:
+        prefix = {
+            "hits": hits or 0,
+            "misses": misses or 0,
+            "hit_ratio": round((hits or 0) / max(1, (hits or 0) + (misses or 0)), 4),
+            "shared_blocks": c("kv_prefix_shared_blocks_total"),
+            "shared_tokens": c("kv_prefix_shared_tokens_total"),
+            "cow_copies": c("kv_prefix_cow_copies_total"),
+            "evicted_blocks": c("kv_prefix_evicted_blocks_total"),
+            "published_blocks": c("kv_prefix_published_blocks_total"),
+            "cached_blocks": g("kv_prefix_cached_blocks"),
+        }
     return {
         "blocks": int(blocks),
         "blocks_in_use": in_use,
@@ -377,6 +398,7 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
         "admit_waits": c("kv_pool_admit_waits_total"),
         "resident_bytes": g("kv_cache_resident_bytes"),
         "capacity_bytes": g("kv_cache_capacity_bytes"),
+        "prefix_cache": prefix,
     }
 
 
@@ -710,6 +732,19 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"resident {kv['resident_bytes']:,} B of worst-case "
                 f"{kv['capacity_bytes']:,} B "
                 f"({kv['resident_bytes'] / kv['capacity_bytes']:.1%})"
+            )
+        pc = kv.get("prefix_cache")
+        if pc:
+            out.append(
+                f"prefix cache: {pc['hits']}/{pc['hits'] + pc['misses']} "
+                f"admissions hit (ratio {pc['hit_ratio']})  "
+                f"shared_blocks={pc['shared_blocks']} "
+                f"shared_tokens={pc['shared_tokens']}"
+            )
+            out.append(
+                f"prefix churn: published={pc['published_blocks']} "
+                f"evicted={pc['evicted_blocks']} cow={pc['cow_copies']} "
+                f"cached_now={pc['cached_blocks']}"
             )
 
     gw = analysis.get("gateway")

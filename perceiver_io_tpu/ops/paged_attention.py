@@ -16,6 +16,12 @@ decode-attention op over that layout, in two implementations:
   is **bitwise identical** to the dense layout (pinned by
   ``tests/test_paged_kv.py``). The gathered view is a transient XLA
   temp, not resident HBM; the persistent footprint is the pool.
+  Sharing-transparent by construction: the gather addresses pages purely
+  through the table, so two slots whose tables alias the SAME physical
+  blocks (cross-request prefix sharing, docs/serving.md "Prefix
+  sharing") read bitwise-identical values — no read-path change was
+  needed for copy-on-write sharing, and the aliased-table parity is
+  pinned by ``tests/test_prefix_cache.py``.
 - **Pallas TPU kernel (opt-in).** ``PERCEIVER_PAGED_KERNEL=1`` on a TPU
   backend dispatches ``jax.experimental.pallas.ops.tpu.paged_attention``
   (the SNIPPETS.md [1] usage), which reads only the live pages — the

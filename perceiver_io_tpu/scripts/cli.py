@@ -222,6 +222,14 @@ class ServeArgs:
     #: pool requires --serve.kv_layout=paged (a dense resolution would
     #: silently discard the budget, so the engine rejects the combination)
     kv_blocks: Optional[int] = None
+    #: cross-request prefix sharing for the paged slot engine
+    #: (docs/serving.md "Prefix sharing"): ``on`` maps hot prompt-prefix
+    #: blocks by reference with copy-on-write instead of re-projecting
+    #: them (greedy output identical; TTFT for a hot system prompt
+    #: collapses to the suffix projection); ``auto`` defers to
+    #: PERCEIVER_PREFIX_CACHE then the measured registry (off when
+    #: unrecorded). ``on`` requires --serve.kv_layout=paged.
+    prefix_cache: str = "auto"
     #: prompt-length bucket grid; default = powers of two up to the context
     prompt_buckets: Optional[typing.Tuple[int, ...]] = None
     #: micro-batch size grid (``bucket`` engine; ignored by ``slots``)
@@ -314,6 +322,33 @@ def _serve_kv_layout(flag_value: str) -> str:
         raise SystemExit(
             f"{strategy_mod.ENV_KV_LAYOUT} must be one of "
             f"{'|'.join(strategy_mod.KV_LAYOUTS)}, got {env_mode!r}"
+        )
+    return env_mode
+
+
+def _serve_prefix_cache(flag_value: str) -> str:
+    """Resolve ``--serve.prefix_cache`` against ``PERCEIVER_PREFIX_CACHE``
+    — the same deference rules as :func:`_serve_kv_layout`: an explicit
+    ``on``/``off`` flag beats the env var; the ``auto`` default defers to
+    it (then to the measured registry at engine construction)."""
+    import os
+
+    from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+
+    if flag_value not in strategy_mod.PREFIX_CACHE_MODES:
+        raise SystemExit(
+            "--serve.prefix_cache must be one of "
+            f"{'|'.join(strategy_mod.PREFIX_CACHE_MODES)}, got {flag_value!r}"
+        )
+    if flag_value != "auto":
+        return flag_value
+    env_mode = os.environ.get(strategy_mod.ENV_PREFIX_CACHE)
+    if not env_mode:
+        return flag_value
+    if env_mode not in strategy_mod.PREFIX_CACHE_MODES:
+        raise SystemExit(
+            f"{strategy_mod.ENV_PREFIX_CACHE} must be one of "
+            f"{'|'.join(strategy_mod.PREFIX_CACHE_MODES)}, got {env_mode!r}"
         )
     return env_mode
 
@@ -890,13 +925,15 @@ class CLI:
                 decode_strategy=decode_mode,
             )
             kv_mode = _serve_kv_layout(args.kv_layout)
+            prefix_mode = _serve_prefix_cache(args.prefix_cache)
             if args.engine == "slots":
                 def make_engine():
                     return SlotServingEngine(
                         model, params, gen_cfg, table, slots=args.slots,
                         prefill_chunk=args.prefill_chunk,
                         kv_layout=kv_mode, kv_block_size=args.kv_block_size,
-                        kv_blocks=args.kv_blocks, **engine_kwargs
+                        kv_blocks=args.kv_blocks, prefix_cache=prefix_mode,
+                        **engine_kwargs
                     )
             else:
                 if args.prefill_chunk is not None:
@@ -916,6 +953,12 @@ class CLI:
                         "--serve.kv_layout/--serve.kv_block_size/"
                         "--serve.kv_blocks apply to --serve.engine=slots "
                         "(the bucket engine has no persistent KV state to page)"
+                    )
+                if args.prefix_cache != "auto":
+                    raise SystemExit(
+                        "--serve.prefix_cache applies to --serve.engine=slots "
+                        "with the paged KV layout (the bucket engine has no "
+                        "block tables to share)"
                     )
 
                 def make_engine():

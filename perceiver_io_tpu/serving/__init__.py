@@ -33,7 +33,7 @@ from perceiver_io_tpu.serving.fleet import (
     Replica,
 )
 from perceiver_io_tpu.serving.gateway import StreamingGateway
-from perceiver_io_tpu.serving.kv_pool import KVPagePool, PoolExhausted
+from perceiver_io_tpu.serving.kv_pool import KVPagePool, PoolExhausted, PrefixBlockIndex
 from perceiver_io_tpu.serving.slots import SlotServingEngine
 
 __all__ = [
@@ -43,6 +43,7 @@ __all__ = [
     "FleetRouter",
     "HEALTH_KEYS",
     "KVPagePool",
+    "PrefixBlockIndex",
     "PoolExhausted",
     "QueueFull",
     "Replica",

@@ -383,7 +383,15 @@ class ServingEngine:
         slot engine's scope), WITHOUT touching the queue or emitting spans;
         returns the resolved config. The fleet router shares it for
         fleet-level admission, so a request that no replica could ever serve
-        rejects at the front door instead of bouncing between replicas."""
+        rejects at the front door instead of bouncing between replicas.
+        The slot engine's override additionally gates on KV-pool capacity
+        (a single request's pages must all fit the pool, a physical bound
+        prefix sharing cannot relax); the scheduler's admission gate is
+        where shareable blocks enter the accounting — referenced prefix
+        blocks are excluded from each admission's reservation, so
+        hot-prefix residents pack concurrently (docs/serving.md "Prefix
+        sharing"). Fleet replicas keep independent caches; replay after a
+        failover re-prefills through the survivor's own index."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cfg = config or self.config
         if prompt.size == 0:

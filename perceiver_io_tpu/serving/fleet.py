@@ -1125,6 +1125,25 @@ class FleetRouter:
         return restarted
 
     # -- observability ------------------------------------------------------
+    def _prefix_cache_rollup(self) -> Optional[dict]:
+        """Summed per-replica prefix-cache hit accounting, or None when no
+        replica shares prefixes (docs/serving.md "Prefix sharing")."""
+        regs: dict = {}
+        for r in self._replicas:
+            if getattr(r.engine, "_prefix_index", None) is not None:
+                regs[id(r.engine.registry)] = r.engine.registry
+        if not regs:
+            return None
+        hits = sum(int(reg.counter("kv_prefix_hits_total")) for reg in regs.values())
+        misses = sum(
+            int(reg.counter("kv_prefix_misses_total")) for reg in regs.values()
+        )
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_ratio": round(hits / max(1, hits + misses), 4),
+        }
+
     def stats(self) -> dict:
         """Fleet counters (canonical ``fleet_*`` names AND the short
         convenience keys), per-replica completion attribution, and each
@@ -1179,6 +1198,13 @@ class FleetRouter:
             },
             "slo": None if self.slo_monitor is None else self.slo_monitor.stats(),
             "slo_sheds": c("fleet_slo_shed_total"),
+            # fleet-wide prefix-sharing rollup (docs/serving.md "Prefix
+            # sharing"): replicas keep INDEPENDENT caches — a failover
+            # replay re-prefills on the survivor and re-hits whatever that
+            # replica's own index holds — so the fleet view is the sum of
+            # per-replica hit accounting (deduped by registry: replicas
+            # sharing one registry already aggregate), not a shared cache's
+            "prefix_cache": self._prefix_cache_rollup(),
             "per_replica": [
                 {
                     "replica_id": r.replica_id,

@@ -721,6 +721,12 @@ class StreamingGateway:
                 "p95": self.registry.percentile("gateway_socket_ttft_ms", 95.0),
             },
             "driver_errors": len(self.driver_errors),
+            # prefix sharing (docs/serving.md "Prefix sharing"): a client
+            # disconnect's cancellation reclaim is refcount-aware — the
+            # cancelled stream's SHARED pages deref (cached prefixes
+            # survive for the next hot admission) while its private pages
+            # free immediately, both within the cancel instant
+            "engine_prefix_cache": getattr(self.engine, "prefix_cache", None),
         }
 
 

@@ -48,6 +48,20 @@ the latent attend + stack and inserts the finished row), one call per
 persistent state never holds a half-built row, so decode steps between
 chunks stay oblivious.
 
+**Prefix sharing** (``prefix_cache="on"``, paged layout only;
+docs/serving.md "Prefix sharing"): a radix index over published full
+prompt-prefix blocks lets an admission whose leading token ids match map
+those pool blocks BY REFERENCE (per-block refcounts), copy-on-write at
+the first divergent or partially-usable block, and prefill only the
+un-shared suffix through the ``start_position``-taking shared executor —
+a fully-hot system prompt admits with zero staged chunks, so TTFT
+collapses to block-table writes plus the latent finalize. A shared page
+is never written through (write routing + the COW guard), frees are
+refcount-aware (a block returns to the pool on its LAST deref), and
+unreferenced cached prefixes LRU-drop under pool pressure before any
+admission is made to wait. Greedy output stays token-identical to the
+unshared path (pinned by ``tests/test_prefix_cache.py``).
+
 **Decode strategy** (``decode_strategy=...`` /
 ``PERCEIVER_DECODE_STRATEGY``): the boundary decode variant's
 implementation — cached migration step vs full windowed recompute — is a
@@ -102,6 +116,7 @@ from perceiver_io_tpu.inference.generate import (
     _decode_step_boundary_paged,
     _prefill_chunk_kv,
     _prefill_finalize,
+    _prefill_finalize_paged,
     _slot_decode_step,
     _slot_decode_step_paged,
     cached_executor,
@@ -113,7 +128,7 @@ from perceiver_io_tpu.inference.generate import (
 from perceiver_io_tpu.inference.samplers import apply_min_new_tokens, sample_logits
 from perceiver_io_tpu.ops import paged_attention as paged_ops
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine, _round_ms
-from perceiver_io_tpu.serving.kv_pool import KVPagePool
+from perceiver_io_tpu.serving.kv_pool import KVPagePool, PrefixBlockIndex
 
 _EXECUTOR_CACHE: dict = register_executor_cache({})
 
@@ -214,7 +229,12 @@ def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m,
         )
 
     new = dict(state)
-    if table_row is None:
+    if "cross_k" not in cache:
+        # prefix-sharing finalize: the cross k/v already live in the pool
+        # (shared blocks + the admission's own staged chunks) — only the
+        # row state and the latent-stack caches get inserted here
+        pass
+    elif table_row is None:
         new["cross_k"] = upd(state["cross_k"], cache["cross_k"])
         new["cross_v"] = upd(state["cross_v"], cache["cross_v"])
     else:
@@ -337,6 +357,85 @@ def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int,
         return jax.lax.cond(is_final, fin, stage, (stage_k, stage_v, state))
 
     return jax.jit(run, donate_argnums=_donate(9, 10, 11))
+
+
+def _build_shared_prefill_executor(model, config: GenerationConfig, chunk: int,
+                                   block_size: int):
+    """The prefix-sharing admission executor (docs/serving.md "Prefix
+    sharing"): ONE compiled program, two ``lax.cond`` branches, taking the
+    admission's **start position** so shared prefix positions are never
+    projected again.
+
+    Stage calls project the ``kv_norm``-side cross k/v of ``chunk``
+    prefix positions (:func:`~perceiver_io_tpu.inference.generate.
+    _prefill_chunk_kv` — per-position math, identical values to the
+    one-shot prefill) and scatter them STRAIGHT INTO THE POOL through the
+    slot's block table; positions outside ``[lo, hi)`` — the un-shared
+    prefix span — route to the null block, so a shared page is never
+    written through (clamped chunk overruns land in trash, exactly the
+    PR-8 write-routing discipline). The pool pages being written are the
+    slot's own private/COW'd pages, invisible to every other slot's
+    gathers, so interleaved decode steps never observe a half-built row.
+
+    The final call runs :func:`~perceiver_io_tpu.inference.generate.
+    _prefill_finalize_paged` — latent projections + pool gather + attend +
+    stack — and inserts the finished row. ``offset``/``lo``/``hi``/``m``/
+    ``slot`` are traced: one program serves every shared-span length of
+    every prompt bucket, so the compile bound grows by exactly one."""
+
+    def run(params, tokens, offset, is_final, window, pad_count, m, slot,
+            table_row, lo, hi, state):
+        table = table_row[None]
+
+        def stage(state):
+            k_c, v_c = model.apply(
+                {"params": params}, tokens, offset, method=_prefill_chunk_kv
+            )
+            pos = offset + jnp.arange(chunk, dtype=jnp.int32)
+            flat = paged_ops.flat_write_indices(table, pos[None, :], block_size)
+            ok = (pos >= lo) & (pos < hi)
+            flat = jnp.where(ok[None, :], flat, pos[None, :] % block_size)
+            pool_k = state["pool_k"].at[flat[0]].set(
+                k_c[0].transpose(1, 0, 2).astype(state["pool_k"].dtype)
+            )
+            pool_v = state["pool_v"].at[flat[0]].set(
+                v_c[0].transpose(1, 0, 2).astype(state["pool_v"].dtype)
+            )
+            return {**state, "pool_k": pool_k, "pool_v": pool_v}
+
+        def fin(state):
+            logits, pool_k, pool_v, cache, length, m_out = model.apply(
+                {"params": params}, window, pad_count, m,
+                state["pool_k"], state["pool_v"], table_row, block_size,
+                method=_prefill_finalize_paged,
+            )
+            state = {**state, "pool_k": pool_k, "pool_v": pool_v}
+            return _insert_row(
+                state, slot, window=window, pad=pad_count, logits=logits,
+                cache=cache, length=length, m=m_out,
+            )
+
+        return jax.lax.cond(is_final, fin, stage, state)
+
+    return jax.jit(run, donate_argnums=_donate(11))
+
+
+def _build_page_copy_executor(block_size: int):
+    """Copy one pool block's k/v content onto another — the device half of
+    copy-on-write (``serving/kv_pool.py``): the host allocator swaps a
+    fresh private block into the writing slot's table and this program
+    makes its content identical to the shared source page before any
+    write lands. ``src``/``dst`` are traced scalars: one compile covers
+    every COW in the engine's lifetime."""
+
+    def run(state, src, dst):
+        idx_src = src * block_size + jnp.arange(block_size)
+        idx_dst = dst * block_size + jnp.arange(block_size)
+        pool_k = state["pool_k"].at[idx_dst].set(state["pool_k"][idx_src])
+        pool_v = state["pool_v"].at[idx_dst].set(state["pool_v"][idx_src])
+        return {**state, "pool_k": pool_k, "pool_v": pool_v}
+
+    return jax.jit(run, donate_argnums=_donate(0))
 
 
 def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
@@ -519,6 +618,22 @@ class _Slot:
 
 
 @dataclasses.dataclass
+class _PrefixPlan:
+    """Host-side record of one admission's prefix-cache match
+    (docs/serving.md "Prefix sharing"): the cached FULL blocks it maps by
+    reference, the optional divergent/partially-usable block it
+    copy-on-writes, and the resulting shared span ``shared_tokens`` —
+    the start position the suffix-only prefill skips to."""
+
+    nodes: list  # fully-shared _PrefixNode chain (mapped by reference)
+    partial: Optional[object]  # COW donor node (divergent / clamped block)
+    shared_tokens: int  # S: prefill projects only [S, prefix_len)
+    bucket_len: int
+    m0: int
+    prefix_len: int
+
+
+@dataclasses.dataclass
 class _ChunkedAdmit:
     """Host-side record of one in-flight chunked admission: the reserved
     slot, the prepared window/row state, the chunk schedule, and the
@@ -536,10 +651,18 @@ class _ChunkedAdmit:
     by_index: np.ndarray  # (n,) ids in token-index space (prompt then pad)
     offsets: List[int]  # staging-chunk start indices; one more pure
     # finalize call follows the last chunk
+    chunk: int = 0  # staging-chunk size C this admission was scheduled with
     next_chunk: int = 0
     stage_k: object = None
     stage_v: object = None
     device_ms: float = 0.0  # summed per-chunk executor time
+    #: prefix-cache match (None = unshared admission). Shared admissions
+    #: stage straight into the pool through the shared prefill executor;
+    #: ``lo``/``hi`` bound the writable span (docs/serving.md "Prefix
+    #: sharing")
+    plan: Optional[_PrefixPlan] = None
+    lo: int = 0
+    hi: int = 0
 
 
 class SlotServingEngine(ServingEngine):
@@ -586,6 +709,19 @@ class SlotServingEngine(ServingEngine):
         fills every slot — requests whose worst case cannot currently fit
         wait at the queue head (``kv_pool_admit_waits_total``), and
         requests that could never fit reject at submit.
+    :param prefix_cache: cross-request prefix sharing — ``"auto" | "on" |
+        "off"`` (docs/serving.md "Prefix sharing"; ``kv_layout="paged"``
+        only). ``on`` keeps a radix index over published full
+        prompt-prefix blocks: an admission whose leading token ids match
+        maps those blocks by reference (per-block refcounts), copy-on-
+        writes at the first divergent or partially-usable block, and
+        prefills ONLY the un-shared suffix — a fully-hot system prompt
+        collapses TTFT to block-table writes plus the latent finalize.
+        Greedy output stays token-identical to the unshared path (pinned
+        by ``tests/test_prefix_cache.py``). Unreferenced cached prefixes
+        are LRU-dropped under pool pressure before an admission is made
+        to wait. ``None`` defers to ``PERCEIVER_PREFIX_CACHE`` then the
+        measured registry (off when unrecorded).
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
@@ -594,7 +730,8 @@ class SlotServingEngine(ServingEngine):
                  decode_strategy: Optional[str] = None,
                  kv_layout: Optional[str] = None,
                  kv_block_size: Optional[int] = None,
-                 kv_blocks: Optional[int] = None, **kwargs):
+                 kv_blocks: Optional[int] = None,
+                 prefix_cache: Optional[str] = None, **kwargs):
         super().__init__(
             model, params, config, table, decode_strategy=decode_strategy,
             **kwargs
@@ -626,6 +763,13 @@ class SlotServingEngine(ServingEngine):
             "kv_pool_block_allocs_total",
             "kv_pool_block_frees_total",
             "kv_pool_admit_waits_total",
+            "kv_prefix_hits_total",
+            "kv_prefix_misses_total",
+            "kv_prefix_shared_blocks_total",
+            "kv_prefix_shared_tokens_total",
+            "kv_prefix_cow_copies_total",
+            "kv_prefix_evicted_blocks_total",
+            "kv_prefix_published_blocks_total",
         )
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._admitting: Optional[_ChunkedAdmit] = None
@@ -657,6 +801,32 @@ class SlotServingEngine(ServingEngine):
                 "silently ignored; pass kv_layout='paged' (sizing the pool "
                 "is choosing the paged layout)"
             )
+        # -- prefix cache (docs/serving.md "Prefix sharing") ---------------
+        # cross-request copy-on-write sharing of hot prompt-prefix blocks;
+        # only meaningful under the paged layout (sharing IS a block-table
+        # property). Resolution mirrors the other axes: explicit arg >
+        # PERCEIVER_PREFIX_CACHE > persisted registry > off.
+        if prefix_cache is not None and \
+                prefix_cache not in decode_strategy_mod.PREFIX_CACHE_MODES:
+            raise ValueError(
+                "prefix_cache must be one of "
+                f"{decode_strategy_mod.PREFIX_CACHE_MODES}, got {prefix_cache!r}"
+            )
+        self.prefix_cache_requested = prefix_cache
+        #: the resolved PREFERENCE (explicit > env > registry > off), kept
+        #: apart from the ACTIVE state: kv_layout="auto" may only switch to
+        #: paged at warmup, and the preference must survive that rebuild
+        #: (the active self.prefix_cache is re-derived per _init_kv_state)
+        self._prefix_pref = decode_strategy_mod.resolve_prefix_cache(
+            prefix_cache, model
+        )
+        if prefix_cache == "on" and resolved != "paged" and kv_layout != "auto":
+            raise ValueError(
+                "prefix_cache='on' shares pool blocks between requests but "
+                f"the KV layout resolved to {resolved!r} — prefix sharing "
+                "requires kv_layout='paged' (dense slots have no block "
+                "tables to share)"
+            )
         self._kv_counter_base = {"allocs": 0, "frees": 0}
         self._kv_waiting_id: Optional[int] = None  # last head counted waiting
         self._init_kv_state(resolved)
@@ -678,6 +848,8 @@ class SlotServingEngine(ServingEngine):
         gauges. Also the warmup-time layout-switch path (an explicit
         ``kv_layout="auto"`` re-resolving after the autotuner) — callers
         must guarantee no residents."""
+        from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
+
         model, params = self.model, self.params
         self.kv_layout = layout
         if layout == "paged":
@@ -689,12 +861,30 @@ class SlotServingEngine(ServingEngine):
                 pool_tokens=self._pool_tokens(),
             )
             self._table_dev = jnp.asarray(self._pool.table())
+            # a state rebuild zeroes the device pool, so the prefix index
+            # starts (over) empty — stale entries must not describe pages
+            # that no longer hold their values. The ACTIVE state re-derives
+            # from the resolved preference here, so a warmup-time
+            # auto-layout switch onto paged turns sharing on rather than
+            # inheriting a stale off from the dense __init__ resolution.
+            self.prefix_cache = "on" if self._prefix_pref == "on" else "off"
+            self._prefix_index: Optional[PrefixBlockIndex] = (
+                PrefixBlockIndex(self.kv_block_size)
+                if self.prefix_cache == "on" else None
+            )
         else:
             self._pool = None
+            self._prefix_index = None
+            self.prefix_cache = "off"
             self._state = _blank_state(
                 model, params, self.slots, self.config.pad_token_id
             )
             self._table_dev = None
+        #: trace-env fingerprint the cached prefix blocks were computed
+        #: under — a mid-process flag flip (fused QKV, flash knobs) changes
+        #: the projection trace, so the index flushes rather than serve
+        #: values from the other regime
+        self._prefix_env = trace_env_fingerprint()
         # analytic worst-case slot-KV footprint (the old
         # kv_cache_resident_bytes meaning): dense per-slot cross caches at
         # full context + the dense latent-stack caches — exact on every
@@ -738,6 +928,10 @@ class SlotServingEngine(ServingEngine):
             self.registry.set_gauge("kv_pool_blocks_in_use", pool.in_use)
             self.registry.set_gauge("kv_pool_blocks_reserved", pool.reserved)
             self.registry.set_gauge("kv_pool_blocks_high_water", pool.high_water)
+            if self._prefix_index is not None:
+                self.registry.set_gauge(
+                    "kv_prefix_cached_blocks", self._prefix_index.cached_blocks
+                )
             base = self._kv_counter_base
             if pool.allocs_total > base["allocs"]:
                 self.registry.inc(
@@ -765,7 +959,12 @@ class SlotServingEngine(ServingEngine):
         retirement vs a client-driven ``cancelled`` reclaim — the long-tail
         HBM-leak class the gateway's disconnect path exists to close."""
         if self._pool is not None:
-            if self._pool.release(slot, cause=cause):
+            # push on UNMAP, not on physical free: a refcount-aware release
+            # can free zero blocks (every page shared) yet still zero the
+            # slot's table row, which the device copy must reflect
+            had_pages = self._pool.mapped_blocks(slot) > 0
+            self._pool.release(slot, cause=cause)
+            if had_pages:
                 self._push_table()
             self._update_kv_gauges()
 
@@ -839,6 +1038,34 @@ class SlotServingEngine(ServingEngine):
             ledger_components=lambda: self._ledger_components(
                 chunk=self.prefill_chunk
             ),
+        )
+
+    def _shared_chunk_size(self) -> int:
+        """Staging-chunk size for shared (prefix-cache hit) admissions:
+        the configured ``prefill_chunk`` when set — so spread shared
+        admissions share the schedule discipline — else a block-scaled
+        default (the suffix past a hot prefix is short by construction)."""
+        n = self.model.max_seq_len
+        return int(self.prefill_chunk or min(n, max(self.kv_block_size, 16)))
+
+    def _shared_prefill_executor(self):
+        chunk = self._shared_chunk_size()
+        return cached_executor(
+            _EXECUTOR_CACHE,
+            self._cache_key("slot_prefill_shared", chunk),
+            lambda: _build_shared_prefill_executor(
+                self.model, self.config, chunk, self.kv_block_size
+            ),
+            ledger_site="slot_prefill_shared",
+            ledger_components=lambda: self._ledger_components(chunk=chunk),
+        )
+
+    def _page_copy_executor(self):
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("kv_page_copy"),
+            lambda: _build_page_copy_executor(self.kv_block_size),
+            ledger_site="kv_page_copy",
+            ledger_components=lambda: self._ledger_components(),
         )
 
     def _boundary_mode(self) -> str:
@@ -924,6 +1151,15 @@ class SlotServingEngine(ServingEngine):
         if self._pool is not None:
             tokens = int(np.asarray(prompt).size) + cfg.max_new_tokens
             need = self._pool.blocks_needed(tokens)
+            # NOTE the never-fits bound is deliberately blind to the prefix
+            # cache: a request's pages must all be DISTINCT resident blocks
+            # simultaneously, shared or not, so sharing cannot relax the
+            # single-request capacity. What sharing relaxes is the
+            # CONCURRENT accounting — referenced blocks are excluded from
+            # each admission's reservation in the scheduler's gate, so
+            # hot-prefix residents pack where unshared ones would wait
+            # (docs/serving.md "Prefix sharing"; the gate is where
+            # feasibility accounts for shareable blocks).
             if need > self._pool.num_blocks:
                 raise ValueError(
                     f"request needs {need} KV blocks ({tokens} positions at "
@@ -933,6 +1169,180 @@ class SlotServingEngine(ServingEngine):
                     "dense layout / bucket engine"
                 )
         return cfg
+
+    # -- prefix sharing (docs/serving.md "Prefix sharing") -------------------
+    def _prefix_plan(self, prompt: np.ndarray,
+                     cfg: GenerationConfig) -> Optional[_PrefixPlan]:
+        """Match the prompt's leading token ids against the prefix index
+        and clamp the usable span to this request's OWN prefix region
+        ``[0, L - m0)`` — latent positions are boundary-normalized per
+        request and migration rewrites from ``L - m0`` up, so only the
+        kv_norm-side prefix is position/token-pure and safely shareable.
+        Returns None on a miss (or when the cache is off/empty)."""
+        index = self._prefix_index
+        if index is None:
+            return None
+        from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
+
+        env = trace_env_fingerprint()
+        if env != self._prefix_env:
+            # a trace-env flip changes the projection programs; cached
+            # values from the other regime must not cross it
+            index.flush(self._pool)
+            self._prefix_env = env
+            self._update_kv_gauges()
+        prompt = np.asarray(prompt).reshape(-1)
+        L = int(prompt.size)
+        bucket_len = self._pick_prompt_bucket(L, cfg)
+        m0 = min(bucket_len, cfg.num_latents)
+        prefix_len = L - m0
+        bs = self.kv_block_size
+        if prefix_len < 1 or not index.cached_blocks:
+            return None
+        nodes = index.match(prompt)
+        max_full = prefix_len // bs
+        full = nodes[:max_full]
+        shared = len(full) * bs
+        partial = None
+        room = prefix_len - shared
+        if room > 0:
+            if len(nodes) > len(full):
+                # the next cached block matches fully but straddles this
+                # request's latent boundary: COW it, use the leading
+                # ``room`` positions, let the finalize rewrite the rest
+                partial, extra = nodes[len(full)], room
+            else:
+                partial, extra = index.best_partial(full, prompt[:prefix_len])
+                if extra < 1:
+                    partial = None
+            if partial is not None:
+                shared += extra
+        if shared < 1:
+            return None
+        if self.prefill_chunk is None and \
+                prefix_len - shared > 4 * self._shared_chunk_size():
+            # small hit, long un-shared suffix, no operator chunk
+            # discipline: the shared path would drain the whole suffix
+            # inline as many fenced stage calls in ONE step — slower than
+            # the single bucket-prefill call a miss dispatches, and a
+            # resident-stalling spike. Treat it as a miss; with
+            # prefill_chunk set the suffix spreads one chunk per step and
+            # any hit pays off.
+            return None
+        return _PrefixPlan(
+            nodes=full, partial=partial, shared_tokens=shared,
+            bucket_len=bucket_len, m0=m0, prefix_len=prefix_len,
+        )
+
+    def _map_shared_prefix(self, req: ServeRequest, slot: int,
+                           plan: _PrefixPlan) -> None:
+        """Reserve + map a hit admission's pool pages: the fully-matched
+        blocks by reference (excluded from the reservation), the partial
+        block shared-then-COW'd (the device page copy runs before any
+        write could land), and the worst-case remainder reserved
+        privately. Counters + the ``serving.prefix_hit`` span event ride
+        here so hit accounting is identical for inline and spread
+        admissions."""
+        pool = self._pool
+        L = int(req.prompt.size)
+        pool.reserve(
+            slot, L + req.config.max_new_tokens, shared_blocks=len(plan.nodes)
+        )
+        blocks = [node.block for node in plan.nodes]
+        if plan.partial is not None:
+            blocks.append(plan.partial.block)
+        pool.map_shared(slot, blocks)
+        if plan.partial is not None:
+            old, new = pool.cow(slot, len(plan.nodes), use_reservation=True)
+            self._state = self._page_copy_executor()(
+                self._state, np.int32(old), np.int32(new)
+            )
+            self.registry.inc("kv_prefix_cow_copies_total")
+        # the shared/COW'd pages may already cover EVERY page this request
+        # will ever touch, in which case no later ensure() maps anything —
+        # the device table must reflect the new mappings before the first
+        # decode gather, so push unconditionally here
+        self._push_table()
+        self.registry.inc("kv_prefix_hits_total")
+        self.registry.inc(
+            "kv_prefix_shared_blocks_total",
+            len(plan.nodes) + (1 if plan.partial is not None else 0),
+        )
+        self.registry.inc("kv_prefix_shared_tokens_total", plan.shared_tokens)
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.prefix_hit", trace_id=req.trace_id, slot=slot,
+                shared_tokens=plan.shared_tokens,
+                shared_blocks=len(plan.nodes),
+                cow=plan.partial is not None,
+            )
+
+    def _publish_prefix(self, req: ServeRequest, slot: int) -> None:
+        """Publish the admitted row's full prefix blocks into the index
+        (first donor wins; already-cached paths are skipped). Runs after
+        the prefill finished, so every published page holds final
+        kv_norm-side values that the donor's own decode never rewrites
+        (migration starts at ``prefix_len``)."""
+        index = self._prefix_index
+        if index is None:
+            return
+        cfg = req.config
+        L = int(req.prompt.size)
+        prefix_len = L - min(self._pick_prompt_bucket(L, cfg), cfg.num_latents)
+        count = prefix_len // self.kv_block_size
+        if count < 1:
+            return
+        published = index.insert(
+            np.asarray(req.prompt).reshape(-1),
+            self._pool.slot_blocks(slot)[:count], self._pool,
+        )
+        if published:
+            self.registry.inc("kv_prefix_published_blocks_total", published)
+            self._update_kv_gauges()
+
+    def _evict_for(self, need: int) -> bool:
+        """LRU-drop unreferenced cached prefixes until ``need`` blocks are
+        reservable — the pool-pressure policy: cached prefixes are a
+        best-effort accelerator and must never starve admissions. Returns
+        True when the need is now reservable."""
+        index = self._prefix_index
+        while not self._pool.can_reserve(need):
+            if index is None:
+                return False
+            freed = index.evict_one(self._pool)
+            if freed is None:
+                return False
+            self.registry.inc("kv_prefix_evicted_blocks_total")
+            if freed:
+                self._update_kv_gauges()
+        return True
+
+    def _cow_guard(self, entry: _Slot, next_len: int) -> bool:
+        """Write-routing guard: a shared page is NEVER written through.
+        Before a decode step, COW any page the step's append/migration
+        writes would land on while it is still shared. Structurally
+        unreachable under the publish policy (shared spans end before
+        ``prefix_len``; writes start at it) — kept as the enforced
+        invariant, pinned by a synthetic drill in
+        ``tests/test_prefix_cache.py``."""
+        if self._prefix_index is None:
+            return False
+        bs = self.kv_block_size
+        pages = {(next_len - 1) // bs}
+        if entry.m >= self.model.max_latents:
+            mig = next_len - 1 - self.model.max_latents
+            if mig >= 0:
+                pages.add(mig // bs)
+        changed = False
+        for page in sorted(pages):
+            if self._pool.page_shared(entry.slot, page):
+                old, new = self._pool.cow(entry.slot, page)
+                self._state = self._page_copy_executor()(
+                    self._state, np.int32(old), np.int32(new)
+                )
+                self.registry.inc("kv_prefix_cow_copies_total")
+                changed = True
+        return changed
 
     # -- slot lifecycle ------------------------------------------------------
     def _update_slot_gauges(self) -> None:
@@ -959,19 +1369,43 @@ class SlotServingEngine(ServingEngine):
                 return i
         return None
 
-    def _chunk_eligible(self, req: ServeRequest) -> bool:
+    def _chunk_eligible(self, req: ServeRequest,
+                        plan: Optional[_PrefixPlan] = None) -> bool:
         """True when this request should be admitted chunk-by-chunk: chunked
         prefill is configured and the prompt's prefix spans more than one
         chunk (shorter prefixes gain nothing over the single-call bucket
-        prefill, which stays the fast path for them)."""
+        prefill, which stays the fast path for them). A prefix-cache hit
+        shrinks the staged span to the UN-shared suffix — a hot prefix
+        with a short suffix admits in one step even under chunking."""
         if self.prefill_chunk is None:
             return False
         cfg = req.config
         bucket_len = self._pick_prompt_bucket(int(req.prompt.size), cfg)
         prefix_len = int(req.prompt.size) - min(bucket_len, cfg.num_latents)
+        if plan is not None:
+            prefix_len -= plan.shared_tokens
         return prefix_len > self.prefill_chunk
 
-    def _admit(self, req: ServeRequest, slot: int) -> None:
+    def _admit(self, req: ServeRequest, slot: int,
+               plan: Optional[_PrefixPlan] = None) -> None:
+        if plan is not None:
+            # prefix-cache hit whose suffix fits one step: run the whole
+            # shared admission (mapping, staged suffix chunks, finalize)
+            # inline through the chunked-admit machinery — one code path
+            # for inline and spread shared admissions. _start_chunked_admit
+            # runs the FIRST executor call itself, so it sits inside the
+            # try: a fault anywhere in the drain must clear the admission
+            # record before step()'s prefill-fault handler rebuilds state,
+            # or the next step() would advance a dead admission and
+            # double-finish the request.
+            try:
+                self._start_chunked_admit(req, slot, plan)
+                while self._admitting is not None:
+                    self._advance_chunked_admit()
+            except Exception:
+                self._admitting = None
+                raise  # step()'s prefill-fault handler releases via _fail_resident
+            return
         cfg = req.config
         bucket_len = self._pick_prompt_bucket(int(req.prompt.size), cfg)
         ids = np.full((1, bucket_len), cfg.pad_token_id, np.int32)
@@ -1022,13 +1456,24 @@ class SlotServingEngine(ServingEngine):
                 "serving.slot_assigned", trace_id=req.trace_id, slot=slot,
                 bucket=bucket_len, prefill_ms=round(prefill_ms, 3),
             )
+        if self._prefix_index is not None:
+            self.registry.inc("kv_prefix_misses_total")
+            self._publish_prefix(req, slot)
 
-    def _start_chunked_admit(self, req: ServeRequest, slot: int) -> None:
+    def _start_chunked_admit(self, req: ServeRequest, slot: int,
+                             plan: Optional[_PrefixPlan] = None) -> None:
         """Begin a chunked admission into ``slot``: build the row's window
         and chunk schedule host-side, allocate the batch-1 staging caches,
         and run the first chunk call (queue wait ends here — the bucket
         engine's prefill-starts convention). Subsequent chunks advance one
-        per ``step()`` until the final call inserts the finished row."""
+        per ``step()`` until the final call inserts the finished row.
+
+        With a prefix-cache ``plan`` the admission is SHARED: cached
+        blocks map by reference up front, the chunk schedule covers only
+        the un-shared suffix ``[shared_tokens, prefix_len)``, staging goes
+        straight into the slot's private pool pages through the shared
+        prefill executor (no batch-1 staging caches), and a fully-hot
+        prefix schedules zero chunks — just the finalize."""
         cfg = req.config
         n = self.model.max_seq_len
         L = int(req.prompt.size)
@@ -1038,28 +1483,43 @@ class SlotServingEngine(ServingEngine):
         window[0, n - L:] = req.prompt
         by_index = np.full((n,), cfg.pad_token_id, np.int32)
         by_index[:L] = req.prompt
-        C = self.prefill_chunk
-        # chunk starts cover the prefix token indices [0, L - m0); starts
+        C = self._shared_chunk_size() if plan is not None else self.prefill_chunk
+        # chunk starts cover the (un-shared) prefix token indices; starts
         # are clamped so a fixed-size chunk never runs past the cache (an
         # overrunning chunk re-covers earlier positions with identical
-        # values, and latent/future positions it grazes are overwritten by
-        # the finalize / masked by length)
-        offsets = [min(o, n - C) for o in range(0, max(L - m0, 1), C)]
-        _, cache_s = _prefill_shapes(self.model, self.params)
+        # values — routed to the null block on the shared path — and
+        # latent/future positions it grazes are overwritten by the
+        # finalize / masked by length)
+        start = plan.shared_tokens if plan is not None else 0
+        if plan is not None:
+            offsets = [min(o, n - C) for o in range(start, plan.prefix_len, C)]
+        else:
+            offsets = [min(o, n - C) for o in range(0, max(L - m0, 1), C)]
         t0 = self._clock()
         req.started_at = t0
         self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
-        if self._pool is not None:
+        stage_k = stage_v = None
+        if plan is not None:
+            # shared path: map the hit's pages (reserve excludes the
+            # referenced blocks; the partial block COWs before any write)
+            self._map_shared_prefix(req, slot, plan)
+            self._update_kv_gauges()
+        elif self._pool is not None:
             # worst-case reservation up front (the admission gate checked
             # capacity); pages map chunk-by-chunk as the staged prefix grows
             self._pool.reserve(slot, L + cfg.max_new_tokens)
             self._update_kv_gauges()
+        if plan is None:
+            _, cache_s = _prefill_shapes(self.model, self.params)
+            stage_k = jnp.zeros(cache_s["cross_k"].shape, cache_s["cross_k"].dtype)
+            stage_v = jnp.zeros(cache_s["cross_v"].shape, cache_s["cross_v"].dtype)
         self._admitting = _ChunkedAdmit(
             req=req, slot=slot, bucket_len=bucket_len, m0=m0,
             window=window, pad=np.asarray([n - L], np.int32),
-            by_index=by_index, offsets=offsets,
-            stage_k=jnp.zeros(cache_s["cross_k"].shape, cache_s["cross_k"].dtype),
-            stage_v=jnp.zeros(cache_s["cross_v"].shape, cache_s["cross_v"].dtype),
+            by_index=by_index, offsets=offsets, chunk=C,
+            stage_k=stage_k, stage_v=stage_v,
+            plan=plan, lo=start,
+            hi=plan.prefix_len if plan is not None else 0,
         )
         self._advance_chunked_admit()
 
@@ -1072,19 +1532,19 @@ class SlotServingEngine(ServingEngine):
         prefill."""
         admit = self._admitting
         req = admit.req
-        C = self.prefill_chunk
+        C = admit.chunk
         i = admit.next_chunk
         final = i == len(admit.offsets)
         # the finalize branch ignores tokens/offset; reuse the first chunk's
         # slice so the call signature stays uniform
         off = 0 if final else admit.offsets[i]
         tokens = jnp.asarray(admit.by_index[off:off + C][None, :])
-        executor = self._chunked_prefill_executor()
         if self._pool is not None:
             # "allocated on chunked-prefill progress": map the pages this
             # call's positions cover — every staged chunk extends the live
             # footprint; the finalize needs the whole prompt mapped before
-            # its pool scatter
+            # its pool scatter. Shared admissions' referenced pages are
+            # already in the table; ensure only extends past them.
             L = int(req.prompt.size)
             covered = L if final else min(off + C, L)
             if self._pool.ensure(admit.slot, covered):
@@ -1094,18 +1554,33 @@ class SlotServingEngine(ServingEngine):
         else:
             table_row = jnp.zeros((self._pages_per_slot(),), jnp.int32)
         t0 = self._clock()
-        admit.stage_k, admit.stage_v, self._state = executor(
-            self.params, tokens, np.int32(off), np.bool_(final),
-            jnp.asarray(admit.window), jnp.asarray(admit.pad),
-            np.int32(admit.m0), np.int32(admit.slot), table_row,
-            admit.stage_k, admit.stage_v, self._state,
-        )
-        # fence the call (host value fetch — same sync discipline as the
-        # bucket prefill path) so the chunk/stall histograms are real
-        if final:
+        if admit.plan is not None:
+            # shared admission: stage straight into the slot's private pool
+            # pages; [lo, hi) bounds the writable span so shared pages are
+            # never written through
+            self._state = self._shared_prefill_executor()(
+                self.params, tokens, np.int32(off), np.bool_(final),
+                jnp.asarray(admit.window), jnp.asarray(admit.pad),
+                np.int32(admit.m0), np.int32(admit.slot), table_row,
+                np.int32(admit.lo), np.int32(admit.hi), self._state,
+            )
+            # fence (host value fetch): the state dict is this program's
+            # output, so one tiny leaf fences the whole call
             np.asarray(self._state["length"])
         else:
-            np.asarray(admit.stage_k[0, 0, 0, 0])
+            executor = self._chunked_prefill_executor()
+            admit.stage_k, admit.stage_v, self._state = executor(
+                self.params, tokens, np.int32(off), np.bool_(final),
+                jnp.asarray(admit.window), jnp.asarray(admit.pad),
+                np.int32(admit.m0), np.int32(admit.slot), table_row,
+                admit.stage_k, admit.stage_v, self._state,
+            )
+            # fence the call (host value fetch — same sync discipline as the
+            # bucket prefill path) so the chunk/stall histograms are real
+            if final:
+                np.asarray(self._state["length"])
+            else:
+                np.asarray(admit.stage_k[0, 0, 0, 0])
         chunk_ms = (self._clock() - t0) * 1e3
         admit.device_ms += chunk_ms
         admit.next_chunk += 1
@@ -1142,6 +1617,12 @@ class SlotServingEngine(ServingEngine):
                     prefill_ms=round(admit.device_ms, 3),
                     chunks=len(admit.offsets),
                 )
+            if self._prefix_index is not None:
+                if admit.plan is None:
+                    self.registry.inc("kv_prefix_misses_total")
+                # publish this row's full prefix blocks (a hit publishes
+                # its EXTENSION blocks — conversation-history growth)
+                self._publish_prefix(req, admit.slot)
 
     def _retire(self, entry: _Slot, status: str, *, error: Optional[str] = None) -> None:
         if status == "ok":
@@ -1171,6 +1652,10 @@ class SlotServingEngine(ServingEngine):
             failed += 1
         if self._pool is not None:
             self._pool.release_all()
+            if self._prefix_index is not None:
+                # the device pool is about to be blanked: cached prefix
+                # blocks would describe zeroed pages — drop them all
+                self._prefix_index.flush(self._pool)
             self._push_table()
             self._update_kv_gauges()
             pool_tokens = self._pool_tokens()
@@ -1265,19 +1750,22 @@ class SlotServingEngine(ServingEngine):
                 disposed += 1
             else:
                 final = admit.next_chunk == len(admit.offsets)
+                shared = admit.plan is not None
                 ran_chunk_call = True
                 try:
                     self._advance_chunked_admit()
                 except Exception as e:
-                    # on CPU a chunk fault only poisons the batch-1 staging
-                    # caches; with donation live (non-CPU) the shared slot
-                    # state was donated into the failed call too, and a
-                    # finalize fault wrote into it on every backend
+                    # on CPU an UNSHARED chunk fault only poisons the
+                    # batch-1 staging caches; a SHARED stage call writes
+                    # pool pages through the live state on every backend,
+                    # and with donation live (non-CPU) the shared slot
+                    # state was donated into the failed call too — as does
+                    # a finalize fault either way
                     self._admitting = None
                     self._kv_release(admit.slot)
                     self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
                     disposed += 1
-                    if final or _donate(0):
+                    if final or shared or _donate(0):
                         return disposed + self._fail_resident(
                             "chunked-prefill fault poisoned the slot state: "
                             f"{type(e).__name__}: {e}"
@@ -1287,6 +1775,39 @@ class SlotServingEngine(ServingEngine):
             if slot is None:
                 break
             head = self._queue[0]
+            plan = None
+            if self._pool is not None:
+                try:
+                    plan = self._prefix_plan(head.prompt, head.config)
+                except Exception:
+                    plan = None  # infeasible heads fail in _admit as before
+
+            def lane_blocked(plan_now):
+                try:
+                    is_chunked = self._chunk_eligible(head, plan_now)
+                except Exception:
+                    is_chunked = False
+                # FIFO: both the spread-chunk path and a shared admission's
+                # inline drain use the single chunked-admit lane, and the
+                # lane runs at most one call per step (a finalize -> first-
+                # chunk handoff in one step would stall residents past the
+                # documented max(chunk, finalize) bound)
+                if (is_chunked or plan_now is not None) and self._admitting is not None:
+                    return True, is_chunked
+                # an inline shared drain is also lane work: it must not run
+                # in the same step the lane already ran a call (finalize ->
+                # inline-drain handoff would stall residents past the bound)
+                return (
+                    (is_chunked or plan_now is not None) and ran_chunk_call,
+                    is_chunked,
+                )
+
+            # lane check BEFORE the evicting gate: a head that cannot admit
+            # this step anyway must not flush cached prefixes to make room
+            # it cannot yet use
+            blocked, chunked = lane_blocked(plan)
+            if blocked:
+                break
             if self._pool is not None:
                 # pool admission gate: the head waits (FIFO — later
                 # requests must not starve it) until retirements free its
@@ -1294,47 +1815,58 @@ class SlotServingEngine(ServingEngine):
                 # requests that could NEVER fit, so this wait terminates.
                 # Counted once per WAITING REQUEST, not per scheduler poll
                 # (a long-blocked head is one wait, however many steps it
-                # spans).
-                need = self._pool.blocks_needed(
-                    int(head.prompt.size) + head.config.max_new_tokens
-                )
+                # spans). Prefix sharing shrinks the need by the
+                # referenced blocks, and under pressure unreferenced
+                # cached prefixes LRU-drop BEFORE the head is made to
+                # wait; each eviction can invalidate the match, so the
+                # plan re-derives until the need is reservable or the
+                # cache is dry.
+                tokens = int(head.prompt.size) + head.config.max_new_tokens
+                while True:
+                    need = self._pool.blocks_needed(tokens) - (
+                        len(plan.nodes) if plan is not None else 0
+                    )
+                    if self._pool.can_reserve(need):
+                        break
+                    if not self._evict_for(need):
+                        break
+                    try:
+                        plan = self._prefix_plan(head.prompt, head.config)
+                    except Exception:
+                        plan = None
                 if not self._pool.can_reserve(need):
                     if self._kv_waiting_id != head.request_id:
                         self._kv_waiting_id = head.request_id
                         self.registry.inc("kv_pool_admit_waits_total")
                     break
-            try:
-                chunked = self._chunk_eligible(head)
-            except Exception:
-                chunked = False  # infeasible heads fail in _admit as before
-            if chunked and (self._admitting is not None or ran_chunk_call):
-                # FIFO: the head needs the chunked-admit lane, which is
-                # either busy or already ran its one call this step (a
-                # finalize->first-chunk handoff in one step would stall
-                # residents past the documented max(chunk, finalize) bound)
-                break
+                # eviction may have shrunk the plan and flipped the head
+                # onto the (busy) chunk lane — re-check before admitting
+                blocked, chunked = lane_blocked(plan)
+                if blocked:
+                    break
             req = self._queue.pop(0)
             if self._apply_request_chaos(req):
                 disposed += 1
                 continue
             if chunked:
                 try:
-                    self._start_chunked_admit(req, slot)
+                    self._start_chunked_admit(req, slot, plan)
                 except Exception as e:
                     # first chunk: staging-only fault on CPU; with donation
-                    # live the slot state went into the failed call too
+                    # live the slot state went into the failed call too —
+                    # and a shared first call writes pool pages directly
                     self._admitting = None
                     self._kv_release(slot)
                     self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
                     disposed += 1
-                    if _donate(0):
+                    if plan is not None or _donate(0):
                         return disposed + self._fail_resident(
                             "chunked-prefill fault poisoned the slot state: "
                             f"{type(e).__name__}: {e}"
                         )
                 continue
             try:
-                self._admit(req, slot)
+                self._admit(req, slot, plan)
             except Exception as e:  # prefill fault: this request + residents
                 self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
                 return disposed + 1 + self._fail_resident(
@@ -1365,6 +1897,9 @@ class SlotServingEngine(ServingEngine):
                 for entry in active:
                     next_len = int(entry.req.prompt.size) + len(entry.emitted) + 1
                     changed |= self._pool.ensure(entry.slot, next_len)
+                    # write-routing invariant: COW any still-shared page
+                    # this step's append/migration would write through
+                    changed |= self._cow_guard(entry, next_len)
                 if changed:
                     self._push_table()
                     self._update_kv_gauges()
@@ -1500,6 +2035,15 @@ class SlotServingEngine(ServingEngine):
                 self._init_kv_state(verdict)
             else:
                 self._update_kv_gauges()
+            if self.prefix_cache_requested == "on" and self.kv_layout != "paged":
+                # the ctor deferred this check for kv_layout="auto" (the
+                # autotuner could still pick paged); it didn't — an
+                # explicit sharing request must not be dropped silently
+                raise ValueError(
+                    "prefix_cache='on' requires kv_layout='paged' but the "
+                    "kv-layout autotuner resolved dense at this shape — "
+                    "pass kv_layout='paged' explicitly to share prefixes"
+                )
         # no residents here (checked above), so re-resolving is safe: the
         # boundary variant compiles against the freshest verdict
         self._pinned_boundary_mode = None
@@ -1538,6 +2082,26 @@ class SlotServingEngine(ServingEngine):
                     self.params, tokens, np.int32(0), np.bool_(final),
                     window, pad, m0, np.int32(0), row0, sk, sv, self._state,
                 )
+        if self._prefix_index is not None:
+            # prefix-sharing executors: the shared (suffix-only) prefill —
+            # both lax.cond branches of one program — and the COW page
+            # copy, so the first hot admission compiles nothing
+            C = self._shared_chunk_size()
+            tokens = jnp.full((1, C), cfg.pad_token_id, jnp.int32)
+            window = jnp.full((1, self.model.max_seq_len), cfg.pad_token_id,
+                              jnp.int32)
+            pad = jnp.zeros((1,), jnp.int32)
+            m0 = np.int32(min(cfg.num_latents, self.model.max_latents))
+            executor = self._shared_prefill_executor()
+            for final in (False, True):
+                self._state = executor(
+                    self.params, tokens, np.int32(0), np.bool_(final),
+                    window, pad, m0, np.int32(0), row0,
+                    np.int32(0), np.int32(0), self._state,
+                )
+            self._state = self._page_copy_executor()(
+                self._state, np.int32(0), np.int32(0)
+            )
         for boundary in (False, True):
             self._rng, key = jax.random.split(self._rng)
             if paged:
@@ -1549,6 +2113,11 @@ class SlotServingEngine(ServingEngine):
                 self._state, _ = self._decode_executor(boundary)(
                     self.params, self._state, key
                 )
+        if self._prefix_index is not None:
+            # the state blank below zeroes the device pool; cached blocks
+            # must not survive it
+            self._prefix_index.flush(self._pool)
+            self._update_kv_gauges()
         self._state = _blank_state(
             self.model, self.params, self.slots, cfg.pad_token_id,
             pool_tokens=self._pool_tokens() if paged else None,
@@ -1592,6 +2161,31 @@ class SlotServingEngine(ServingEngine):
                 ),
                 "capacity_bytes": self._kv_capacity_bytes,
             }
+            out["prefix_cache"] = {"enabled": self._prefix_index is not None}
+            if self._prefix_index is not None:
+                hits = int(counts.get("kv_prefix_hits_total", 0))
+                misses = int(counts.get("kv_prefix_misses_total", 0))
+                out["prefix_cache"].update({
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_ratio": round(hits / max(1, hits + misses), 4),
+                    "shared_blocks": int(
+                        counts.get("kv_prefix_shared_blocks_total", 0)
+                    ),
+                    "shared_tokens": int(
+                        counts.get("kv_prefix_shared_tokens_total", 0)
+                    ),
+                    "cow_copies": int(
+                        counts.get("kv_prefix_cow_copies_total", 0)
+                    ),
+                    "evicted": int(
+                        counts.get("kv_prefix_evicted_blocks_total", 0)
+                    ),
+                    "published": int(
+                        counts.get("kv_prefix_published_blocks_total", 0)
+                    ),
+                    **self._prefix_index.stats(),
+                })
         return out
 
     def health(self) -> dict:
@@ -1600,4 +2194,5 @@ class SlotServingEngine(ServingEngine):
         out["slots_active"] = sum(1 for s in self._slots if s is not None)
         out["admitting"] = self._admitting is not None
         out["kv_layout"] = self.kv_layout
+        out["prefix_cache"] = self.prefix_cache
         return out

@@ -63,6 +63,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "prefix_cache: cross-request prefix-sharing test (COW/refcounted "
+        "blocks, radix index, suffix-only prefill; serving/kv_pool.py, "
+        "serving/slots.py; docs/serving.md \"Prefix sharing\"); CPU-fast, "
+        "runs in the tier-1 suite with a per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: SLO telemetry test (per-token latency accounting, burn-rate "
         "monitor, load generator, telemetry-driven fleet admission; "
         "observability/slo.py, observability/loadgen.py; "
