@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos obs obs-report slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench prefix-cache prefix-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench prefix-cache prefix-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -18,6 +18,29 @@ chaos:
 # circuit breakers, exactly-once recovery drills — CPU-fast, also tier-1
 fleet-chaos:
 	$(PY) -m pytest tests/ -q -m fleet --continue-on-collection-errors
+
+# fleet-elasticity suite (docs/serving.md "Elasticity"): burn-rate
+# autoscaler ladder drills, zero-downtime scale-down with exactly-once
+# replay, spike-arrival loadgen, healthz-stays-ready pins — CPU-fast,
+# also tier-1, per-test timeout budget via the conftest SIGALRM guard
+elasticity:
+	$(PY) -m pytest tests/ -q -m elasticity --continue-on-collection-errors
+
+# flash-crowd elasticity A/B at the CPU-fallback shape (docs/serving.md
+# "Elasticity"): the same deterministic FakeClock spike offered to a
+# static fleet and an autoscaled one — goodput-under-SLO both ways, the
+# scale-event timeline, zero-drop / token-identity / pool zero-leak pins
+elasticity-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'elasticity': bench._bench_elasticity(model, params, cfg)}, indent=2))"
 
 # unified telemetry layer suite (docs/observability.md) — CPU-fast,
 # also included in the tier-1 "not slow" run

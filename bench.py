@@ -601,6 +601,26 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "fleet_chaos": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: elasticity A/B (autoscaled vs static fleet flash crowd) ----
+        if left() > 120.0:
+            log("run: elasticity probe (flash crowd: breach -> scale-up -> "
+                "recover -> scale-down, vs a static fleet)")
+            try:
+                ela = _bench_elasticity(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "elasticity": ela})
+                log(f"run: elasticity goodput-under-SLO "
+                    f"{ela['autoscaled']['goodput_under_slo']} autoscaled vs "
+                    f"{ela['static']['goodput_under_slo']} static "
+                    f"(beats={ela['elastic_beats_static']}, scale_ups "
+                    f"{ela['autoscaled']['scale_ups']}, scale_downs "
+                    f"{ela['autoscaled']['scale_downs']}, zero_dropped="
+                    f"{ela['zero_dropped']}, token_identical="
+                    f"{ela['token_identical']})")
+            except Exception as e:
+                log(f"run: elasticity probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "elasticity": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: observability probe (telemetry layer end to end) ----
         if left() > 60.0:
             log("run: observability probe (histograms / goodput / MFU gauges)")
@@ -1684,6 +1704,256 @@ def _bench_fleet_chaos(model, params, cfg, *, n_requests: int = 8,
         ),
         "goodput_tokens_per_sec": round(completed * new_tokens / wall_s, 2),
         "wall_s": round(wall_s, 3),
+    }
+
+
+def _bench_elasticity(model, params, cfg, *, n_requests: int = 24,
+                      new_tokens: int = 8, slots: int = 1,
+                      max_replicas: int = 3, spike_factor: float = 3.0):
+    """Fleet-elasticity A/B (docs/serving.md "Elasticity"): the SAME
+    deterministic FakeClock flash crowd — baseline Poisson with a
+    ``spike_factor``x step (the loadgen ``spike`` arrival) at ~3x one
+    replica's capacity — offered to (a) a STATIC single-replica fleet and
+    (b) the same fleet behind a :class:`FleetAutoscaler` bounded at
+    ``max_replicas``. Both runs share the SLO targets calibrated from a
+    healthy closed-loop pass, and goodput-under-SLO is per-point: a
+    request is GOOD when it completed AND its own first-token latency met
+    the TTFT target (joined from its ``serving.first_token`` event).
+
+    The probe reports both runs' SLO-goodput, the autoscaled run's
+    breach -> scale-up -> recovery -> cooldown-gated scale-down timeline
+    (``autoscaler.*`` events), and the acceptance pins: the autoscaled
+    fleet's goodput-under-SLO beats the static baseline, NO accepted
+    request is dropped across the scale transitions, completed outputs are
+    token-identical between the two runs (greedy determinism — scale
+    churn adds capacity, not entropy), and the scale-down victim's pool
+    accounting is zero-leak with its frees tagged ``scale_down``.
+    Everything but wall time replays bit-identically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.observability import (
+        LoadGenerator,
+        MetricsRegistry,
+        Tracer,
+        TTFTProbe,
+        WorkloadSpec,
+    )
+    from perceiver_io_tpu.observability.slo import SLOMonitor, SLOPolicy
+    from perceiver_io_tpu.reliability.chaos import FakeClock
+    from perceiver_io_tpu.serving import (
+        BucketTable,
+        FleetAutoscaler,
+        FleetRouter,
+        SlotServingEngine,
+    )
+
+    params = cast_float_params(params, jnp.bfloat16)
+    num_latents = min(4, cfg.max_latents)
+    max_len = min(
+        16, cfg.max_seq_len - new_tokens,
+        cfg.max_seq_len - cfg.max_latents + num_latents,
+    )
+    table = BucketTable(prompt_lens=(max_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    workload = WorkloadSpec(
+        prompt_len=(max(2, max_len // 2), max_len),
+        max_new_tokens=(max(2, 3 * new_tokens // 4), new_tokens),
+        vocab=(1, cfg.vocab_size),
+    )
+    step_cost_s = 0.01
+
+    def build(clock, *, autoscale: bool, registry, tracer, monitor):
+        def factory():
+            return SlotServingEngine(
+                model, params, gcfg, table, slots=slots, clock=clock,
+                kv_layout="paged", rng=jax.random.PRNGKey(3),
+            )
+
+        fleet = FleetRouter(
+            [factory], clock=clock, registry=registry, tracer=tracer,
+            slo_monitor=monitor,
+        )
+        scaler = None
+        if autoscale:
+            scaler = FleetAutoscaler(
+                fleet, min_replicas=1, max_replicas=max_replicas,
+                up_cooldown_s=0.3, down_cooldown_s=2.0,
+                up_evidence=2, down_evidence=25,
+                queue_high=1.0, queue_low=0.5,
+            )
+        return fleet, scaler
+
+    # warm the executor grid once; every later replica (initial or
+    # autoscaler-spawned) reuses the process-global caches
+    SlotServingEngine(
+        model, params, gcfg, table, slots=slots, kv_layout="paged",
+    ).warmup()
+
+    # calibration: a healthy closed-loop pass on one static replica sets
+    # capacity (completed req/s on the fake clock) and the TTFT target
+    cal_clock = FakeClock()
+    cal_fleet, _ = build(
+        cal_clock, autoscale=False, registry=MetricsRegistry(clock=cal_clock),
+        tracer=None, monitor=None,
+    )
+    cal = LoadGenerator(
+        cal_fleet, workload=workload, mode="closed", users=max(1, slots),
+        max_requests=max(6, n_requests // 4), rng=0, clock=cal_clock,
+        step_cost_s=step_cost_s,
+    ).run()
+    base_rps = max(cal["completed_rps"], 0.1)
+    cal_reg = cal_fleet.registry
+    # target floor = a few scheduler passes: an unqueued FakeClock request
+    # can see TTFT 0 (tokens materialize before the pass's clock charge),
+    # so the calibration p95 alone can undershoot the service floor
+    slo_ttft_ms = round(
+        3.0 * max(
+            cal_reg.percentile("serving_ttft_ms", 95.0) or 0.0,
+            step_cost_s * 1e3,
+        ), 3,
+    )
+    spike_start_s = 1.0
+    spike_duration_s = 4.0
+
+    def run(autoscale: bool):
+        clock = FakeClock()
+        registry = MetricsRegistry(clock=clock)
+        tracer = Tracer(clock=clock)
+        monitor = SLOMonitor(
+            SLOPolicy(ttft_p95_ms=slo_ttft_ms), clock=clock,
+            registry=registry, tracer=tracer,
+            fast_window_s=1.0, slow_window_s=4.0,
+            breach_burn_rate=1.5, min_samples=4,
+        )
+        fleet, scaler = build(
+            clock, autoscale=autoscale, registry=registry, tracer=tracer,
+            monitor=monitor,
+        )
+        # client-side per-request TTFT through the on_token sink — the
+        # fleet-drill goodput join (the engines' serving.first_token
+        # events carry per-replica trace ids, not the fleet handle's)
+        probe = TTFTProbe(fleet, clock)
+        gen = LoadGenerator(
+            probe, workload=workload, mode="open", arrival="spike",
+            rate_rps=0.8 * base_rps, spike_factor=spike_factor,
+            spike_start_s=spike_start_s, spike_duration_s=spike_duration_s,
+            max_requests=n_requests, config=gcfg, rng=1, clock=clock,
+            step_cost_s=step_cost_s,
+        )
+        report = gen.run()
+        # settle: keep the control loop polling after the crowd passes so
+        # recovery evidence accumulates and the cooldown-gated scale-down
+        # fires (bounded — the drill must terminate even if it never does)
+        for _ in range(600):
+            if scaler is None or len(fleet.replicas) <= scaler.min_replicas:
+                break
+            fleet.step()
+            clock.advance(step_cost_s)
+        good = probe.good_under(slo_ttft_ms)
+        return {
+            "fleet": fleet, "scaler": scaler, "gen": gen, "probe": probe,
+            "report": report, "registry": registry, "tracer": tracer,
+            "good": good,
+            "goodput_under_slo": round(good / max(1, report["offered"]), 4),
+        }
+
+    static = run(False)
+    auto = run(True)
+
+    # token identity: same rng -> same offered prompt sequence; every
+    # request completed in BOTH runs must match bit-for-bit. Pair by the
+    # probe's OFFERED index, not positionally — the runs shed differently
+    # (that asymmetry is the whole point of the A/B), so the accepted
+    # handle lists misalign as soon as one run drops an offer
+    def _by_index(r):
+        return {
+            rec["index"]: rec["handle"] for rec in r["probe"].records
+            if rec["handle"] is not None
+        }
+
+    auto_h, static_h = _by_index(auto), _by_index(static)
+    pairs = [
+        (auto_h[i], static_h[i]) for i in sorted(set(auto_h) & set(static_h))
+        if auto_h[i].status == "ok" and static_h[i].status == "ok"
+    ]
+    token_identical = bool(pairs) and all(
+        np.array_equal(a.result, s.result) for a, s in pairs
+    )
+    scaler = auto["scaler"]
+    fleet = auto["fleet"]
+    counts = auto["registry"].counters()
+    live_pools = [
+        r.engine._pool for r in fleet.replicas if r.engine._pool is not None
+    ]
+    retired_pools = [r["pool"] for r in scaler.retired if r["pool"]]
+    timeline = [
+        {"at_s": round(sp.start_s, 4), "event": sp.name,
+         **{k: sp.attrs[k] for k in ("reason", "replica", "rung",
+                                     "replicas_after") if k in sp.attrs}}
+        for sp in auto["tracer"].spans()
+        if sp.name.startswith(("autoscaler.", "slo."))
+    ]
+    s = fleet.stats()
+    return {
+        "requests": n_requests,
+        "slots": slots,
+        "max_replicas": max_replicas,
+        "spike_factor": spike_factor,
+        "slo_ttft_ms": slo_ttft_ms,
+        "capacity_rps": round(base_rps, 4),
+        "static": {
+            "goodput_under_slo": static["goodput_under_slo"],
+            "completed": static["report"]["completed"],
+            "p95_ttft_ms": round(
+                static["registry"].percentile("serving_ttft_ms", 95.0) or 0.0, 3
+            ),
+        },
+        "autoscaled": {
+            "goodput_under_slo": auto["goodput_under_slo"],
+            "completed": auto["report"]["completed"],
+            "p95_ttft_ms": round(
+                auto["registry"].percentile("serving_ttft_ms", 95.0) or 0.0, 3
+            ),
+            "scale_ups": scaler.scale_ups,
+            "scale_downs": scaler.scale_downs,
+            "breaches": int(counts.get("slo_breach_total", 0)),
+            "replicas_final": len(fleet.replicas),
+            "rung_final": scaler.rung,
+        },
+        "goodput_ratio_vs_static": round(
+            auto["goodput_under_slo"] / max(static["goodput_under_slo"], 1e-4), 4
+        ),
+        # acceptance pins (tests/test_elasticity.py asserts these)
+        "elastic_beats_static": (
+            auto["goodput_under_slo"] > static["goodput_under_slo"]
+        ),
+        "zero_dropped": (
+            s["completed"] + s["timed_out"] + s["failed"]
+            == s["submitted"] and s["queued"] == 0 and s["dispatched"] == 0
+            and s["failed"] == 0
+        ),
+        "token_identical": token_identical,
+        "pool_zero_leak": (
+            all(p["leaked"] == 0 and p["in_use"] == 0 for p in retired_pools)
+            and all(p.leaked() == 0 and p.in_use == 0 for p in live_pools)
+        ),
+        # a victim that still held in-flight work at removal tags its
+        # frees "scale_down"; one already idle freed on ordinary retire —
+        # either way every page was returned (tests/test_elasticity.py
+        # pins the tag itself on a mid-flight remove_replica)
+        "scale_down_clean": (
+            None if not retired_pools else all(
+                "scale_down" in p["frees_by_cause"]
+                or (p["in_use"] == 0 and p["leaked"] == 0)
+                for p in retired_pools
+            )
+        ),
+        "retired": scaler.retired,
+        "timeline": timeline,
     }
 
 

@@ -55,6 +55,7 @@ from perceiver_io_tpu.observability.loadgen import (
     GatewayHttpClient,
     HttpStreamHandle,
     LoadGenerator,
+    TTFTProbe,
     WorkloadSpec,
 )
 from perceiver_io_tpu.observability.registry import (
@@ -117,6 +118,7 @@ __all__ = [
     "JsonlSpanSink",
     "LedgeredExecutor",
     "LoadGenerator",
+    "TTFTProbe",
     "MetricsRegistry",
     "ObservabilityArgs",
     "ProfilerTrigger",

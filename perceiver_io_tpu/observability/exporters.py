@@ -121,7 +121,16 @@ HELP_TEXT = {
     "fleet_slo_shed_total": "Sheds caused by SLO-tightened admission (also counted in fleet_requests_shed_total).",
     "fleet_replicas": "Replicas owned by the fleet router.",
     "fleet_replicas_healthy": "Replicas with a closed circuit breaker right now.",
+    "fleet_replicas_draining": "Replicas currently draining (rolling restart or scale-down in progress).",
     "fleet_request_latency_ms": "Fleet request latency: submit to terminal state (failovers included).",
+    "fleet_scale_up_total": "Replicas added to the fleet (autoscaler- or operator-driven).",
+    "fleet_scale_down_total": "Replicas retired from the fleet with exactly-once failover of their in-flight work.",
+    "fleet_scale_up_failed_total": "Replica spawn attempts that failed (factory raise / fleet.scale_up chaos fault).",
+    "autoscaler_evaluations_total": "Autoscaler control-loop polls (one per fleet scheduling pass).",
+    "autoscaler_holds_total": "Scale actions suppressed by cooldown or victim ineligibility (hysteresis at work).",
+    "autoscaler_ladder_rung": "Current degradation-ladder rung index (0 steady, 1 tighten, 2 scale-up, 3 shed, 4 recover).",
+    "autoscaler_breach_streak": "Consecutive polls of fresh scale-up evidence (breach / queue pressure / unhealthy capacity).",
+    "autoscaler_healthy_streak": "Consecutive polls of fresh scale-down evidence (no breach, queue under the low watermark).",
     "gateway_connections_total": "TCP connections accepted by the HTTP streaming gateway.",
     "gateway_connections_active": "Gateway connections open right now.",
     "gateway_streams_total": "Generate streams accepted (submission admitted, response streaming).",

@@ -18,8 +18,12 @@ Gemma-on-TPU comparison sweeps offered load open-loop):
   benchmarks hide). Processes: ``poisson`` (exponential inter-arrivals at
   ``rate_rps``), ``bursty`` (bursts of ``burst_size`` back to back, burst
   starts Poisson at ``rate_rps / burst_size``), ``ramp`` (rate ramps
-  linearly from ``rate_rps`` to ``ramp_to_rps`` across the run), and
-  ``uniform`` (fixed spacing — the deterministic baseline).
+  linearly from ``rate_rps`` to ``ramp_to_rps`` across the run),
+  ``uniform`` (fixed spacing — the deterministic baseline), and ``spike``
+  (Poisson at ``rate_rps`` with a ``spike_factor``× rate step over the
+  window ``[spike_start_s, spike_start_s + spike_duration_s)`` — the
+  flash-crowd workload the fleet-elasticity drill and the
+  ``extras.elasticity`` bench offer; docs/serving.md "Elasticity").
 - **Closed loop** — ``users`` synthetic users each keep one request in
   flight: submit, await completion, think
   (``workload.think_time_s``), resubmit. Offered load self-limits to
@@ -65,7 +69,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
-ARRIVALS = ("poisson", "bursty", "ramp", "uniform")
+ARRIVALS = ("poisson", "bursty", "ramp", "uniform", "spike")
 MODES = ("open", "closed")
 
 
@@ -313,6 +317,66 @@ class GatewayHttpClient:
             conn.close()
 
 
+class TTFTProbe:
+    """Engine-surface proxy recording CLIENT-SIDE per-request TTFT through
+    the ``on_token`` sink: ``submit`` stamps the clock, the first index-0
+    token stamps it again (a fleet failover replay re-fires index 0 — the
+    FIRST observation wins, matching the wire dedupe). Point a
+    :class:`LoadGenerator` at ``TTFTProbe(fleet, clock)`` and every
+    accepted request gains a ``{"index", "ttft_ms", "handle"}`` row in
+    :attr:`records`, submit-ordered — the per-request goodput-under-SLO
+    join for FLEET drills, where the engines' ``serving.first_token``
+    events carry per-replica trace ids that never match the fleet
+    handle's (single-engine drills can keep joining on the tracer).
+    ``index`` is the request's position in the OFFERED sequence (shed /
+    rejected offers advance it without leaving a record), so two runs of
+    the same workload pair their common requests by ``index`` even when
+    they shed differently. Everything else proxies, so the generator's
+    accounting is unchanged."""
+
+    def __init__(self, engine, clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self._clock = clock
+        self.offered = 0
+        self.records: List[dict] = []
+
+    def submit(self, prompt, config=None, **kwargs):
+        idx = self.offered
+        self.offered += 1
+        rec = {"index": idx, "ttft_ms": None, "handle": None}
+        t0 = self._clock()
+        user_sink = kwargs.pop("on_token", None)
+
+        def on_token(index: int, token: int) -> None:
+            if index == 0 and rec["ttft_ms"] is None:
+                rec["ttft_ms"] = (self._clock() - t0) * 1e3
+            if user_sink is not None:
+                user_sink(index, token)
+
+        handle = self.engine.submit(prompt, config, on_token=on_token, **kwargs)
+        rec["handle"] = handle
+        self.records.append(rec)
+        return handle
+
+    def step(self) -> int:
+        return self.engine.step()
+
+    def pending(self) -> bool:
+        return self.engine.pending()
+
+    def health(self) -> dict:
+        return self.engine.health()
+
+    def good_under(self, ttft_target_ms: float) -> int:
+        """Requests that completed AND whose own first token met the
+        target — the shared per-request goodput numerator."""
+        return sum(
+            1 for r in self.records
+            if r["handle"] is not None and r["handle"].status == "ok"
+            and r["ttft_ms"] is not None and r["ttft_ms"] <= ttft_target_ms
+        )
+
+
 class LoadGenerator:
     """Drive an engine/fleet with a synthetic workload (module docstring).
 
@@ -325,6 +389,11 @@ class LoadGenerator:
         ``ramp`` the starting rate.
     :param ramp_to_rps: ``ramp``'s final rate, reached at the last arrival.
     :param burst_size: ``bursty``'s requests per burst.
+    :param spike_factor: ``spike``'s rate multiplier inside the window
+        (offered rate = ``spike_factor * rate_rps`` there, ``rate_rps``
+        outside).
+    :param spike_start_s / spike_duration_s: the spike window, in seconds
+        from the first arrival draw.
     :param users: closed-loop concurrent synthetic users.
     :param max_requests: total requests to offer, then drain and stop.
     :param config: optional :class:`GenerationConfig` template; each
@@ -346,7 +415,10 @@ class LoadGenerator:
     def __init__(self, engine, *, workload: Optional[WorkloadSpec] = None,
                  mode: str = "open", arrival: str = "poisson",
                  rate_rps: float = 10.0, ramp_to_rps: Optional[float] = None,
-                 burst_size: int = 4, users: int = 4, max_requests: int = 32,
+                 burst_size: int = 4, spike_factor: float = 4.0,
+                 spike_start_s: float = 0.0,
+                 spike_duration_s: Optional[float] = None,
+                 users: int = 4, max_requests: int = 32,
                  config=None, deadline_s: Optional[float] = None,
                  rng=0, clock: Callable[[], float] = time.monotonic,
                  step_cost_s: float = 0.001):
@@ -368,6 +440,20 @@ class LoadGenerator:
             raise ValueError(
                 f"arrival='ramp' needs ramp_to_rps > 0, got {ramp_to_rps}"
             )
+        if arrival == "spike":
+            if spike_factor <= 0:
+                raise ValueError(
+                    f"arrival='spike' needs spike_factor > 0, got {spike_factor}"
+                )
+            if spike_duration_s is None or spike_duration_s <= 0:
+                raise ValueError(
+                    f"arrival='spike' needs spike_duration_s > 0, "
+                    f"got {spike_duration_s}"
+                )
+            if spike_start_s < 0:
+                raise ValueError(
+                    f"spike_start_s must be >= 0, got {spike_start_s}"
+                )
         if step_cost_s <= 0:
             # under a FakeClock the step cost is the only thing that moves
             # time while the engine works; zero would spin the open loop
@@ -380,6 +466,11 @@ class LoadGenerator:
         self.rate_rps = float(rate_rps)
         self.ramp_to_rps = None if ramp_to_rps is None else float(ramp_to_rps)
         self.burst_size = int(burst_size)
+        self.spike_factor = float(spike_factor)
+        self.spike_start_s = float(spike_start_s)
+        self.spike_duration_s = (
+            None if spike_duration_s is None else float(spike_duration_s)
+        )
         self.users = int(users)
         self.max_requests = int(max_requests)
         self.config = config
@@ -431,6 +522,30 @@ class LoadGenerator:
                     gaps.append(float(rng.exponential(burst_gap)))
                 else:
                     gaps.append(0.0)
+            return gaps
+        if self.arrival == "spike":
+            # flash crowd: baseline Poisson with a K-step over the window.
+            # The schedule is simulated arrival-time-forward so the rate a
+            # gap is drawn at depends on WHEN the previous arrival landed —
+            # the step is a property of the offered timeline, not of an
+            # arrival index
+            gaps = []
+            t = 0.0
+            spike_end = self.spike_start_s + self.spike_duration_s
+            for _ in range(n):
+                in_spike = self.spike_start_s <= t < spike_end
+                rate = self.rate_rps * (self.spike_factor if in_spike else 1.0)
+                gap = float(rng.exponential(1.0 / rate))
+                # a baseline gap that would leap the whole window still
+                # offers the spike: clip the draw to the window start so
+                # the crowd actually arrives (the window is the event, the
+                # gap is just the sampler)
+                if not in_spike and t < self.spike_start_s \
+                        and t + gap > self.spike_start_s:
+                    gap = self.spike_start_s - t
+                    gap = max(gap, 1e-9)
+                gaps.append(gap)
+                t += gap
             return gaps
         # ramp: rate interpolates rate_rps -> ramp_to_rps across arrivals
         gaps = []

@@ -141,6 +141,73 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
         "kv_pool": _kv_pool_section(snapshot),
         "slo": _slo_section(events, snapshot),
         "gateway": _gateway_section(events, snapshot),
+        "elasticity": _elasticity_section(events, snapshot),
+    }
+
+
+def _elasticity_section(events: List[dict], snapshot: dict) -> Optional[dict]:
+    """Fleet-elasticity rollup (docs/serving.md "Elasticity"): the
+    scale-event timeline from the ``autoscaler.*`` events (scale-up/-down
+    transitions, spawn failures, ladder-rung changes, each with its
+    replica-count attrs), scale counts from the ``fleet_scale_*`` counters,
+    and the autoscaler's ladder/hysteresis gauges. None when the run had no
+    elasticity (pre-autoscaler artifacts stay unchanged)."""
+    counters = snapshot.get("counters") or {}
+    gauges = snapshot.get("gauges") or {}
+    scale_events = [
+        r for r in events
+        if (r.get("span") or "").startswith("autoscaler.")
+    ]
+    # the fleet pre-declares fleet_scale_* at 0 (FLEET_COUNTERS), so key
+    # PRESENCE means "a fleet ran", not "elasticity happened" — require an
+    # autoscaler (its counters only exist when one was built), a nonzero
+    # scale count (operator-driven add/remove), or an autoscaler.* event
+    has_elasticity = bool(scale_events) or any(
+        k.startswith("autoscaler_") for k in counters
+    ) or any(
+        counters.get(k) for k in counters if k.startswith("fleet_scale_")
+    )
+    if not has_elasticity:
+        return None
+
+    def c(name: str) -> Optional[int]:
+        v = counters.get(name)
+        return None if v is None else int(v)
+
+    t0 = min(
+        (r["start_s"] for r in events
+         if isinstance(r.get("start_s"), (int, float))),
+        default=0.0,
+    )
+    timeline = []
+    by_event: Dict[str, int] = {}
+    for r in sorted(scale_events, key=lambda r: r.get("start_s") or 0.0):
+        name = r.get("span", "?")
+        by_event[name] = by_event.get(name, 0) + 1
+        attrs = r.get("attrs") or {}
+        timeline.append({
+            "offset_s": round(float(r.get("start_s") or t0) - t0, 6),
+            "event": name,
+            "replica": attrs.get("replica"),
+            "reason": attrs.get("reason"),
+            "rung": attrs.get("rung"),
+            "replicas_after": attrs.get("replicas_after"),
+            "in_flight_replayed": attrs.get("in_flight_replayed"),
+        })
+    rung = gauges.get("autoscaler_ladder_rung")
+    return {
+        "scale_ups": c("fleet_scale_up_total"),
+        "scale_downs": c("fleet_scale_down_total"),
+        "spawn_failures": c("fleet_scale_up_failed_total"),
+        "evaluations": c("autoscaler_evaluations_total"),
+        "holds": c("autoscaler_holds_total"),
+        "ladder_rung": None if rung is None else int(rung),
+        "replicas": (
+            None if gauges.get("fleet_replicas") is None
+            else int(gauges["fleet_replicas"])
+        ),
+        "events_by_kind": dict(sorted(by_event.items())),
+        "timeline": timeline,
     }
 
 
@@ -669,6 +736,42 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"replica_restarts={fleet['replica_restarts']}  "
                 f"duplicates_ignored={fleet['duplicates_ignored']}"
             )
+
+    elastic = analysis.get("elasticity")
+    if elastic:
+        out.append("")
+        out.append("== elasticity ==")
+
+        def ev(value):
+            return "-" if value is None else value
+
+        out.append(
+            f"scale_ups={ev(elastic['scale_ups'])}  "
+            f"scale_downs={ev(elastic['scale_downs'])}  "
+            f"spawn_failures={ev(elastic['spawn_failures'])}  "
+            f"evaluations={ev(elastic['evaluations'])}  "
+            f"holds={ev(elastic['holds'])}"
+            + (
+                f"  ladder_rung={elastic['ladder_rung']}"
+                if elastic["ladder_rung"] is not None else ""
+            )
+            + (
+                f"  replicas_now={elastic['replicas']}"
+                if elastic["replicas"] is not None else ""
+            )
+        )
+        if elastic["timeline"]:
+            out.append("scale-event timeline:")
+            for row in elastic["timeline"]:
+                detail = "".join(
+                    f" {k}={row[k]}" for k in
+                    ("replica", "reason", "rung", "replicas_after",
+                     "in_flight_replayed")
+                    if row.get(k) is not None
+                )
+                out.append(
+                    f"  +{row['offset_s']:>10.3f} s  {row['event']:<24}{detail}"
+                )
 
     slo = analysis.get("slo")
     if slo:

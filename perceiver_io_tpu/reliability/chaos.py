@@ -42,6 +42,24 @@ site                        keying
                             freeing the slot and its pool pages — the
                             mass-abandonment drill
                             (:meth:`ChaosRegistry.disconnect_stream`)
+``fleet.scale_up``          execution count (1-based): the Nth replica
+                            spawn attempt (``FleetRouter.add_replica`` —
+                            autoscaler- or operator-driven alike).
+                            ``error`` models a SPAWN FAILURE: the new
+                            replica's process never comes up — counted
+                            ``fleet_scale_up_failed_total``, and the
+                            autoscaler holds its up-cooldown instead of
+                            spinning (:meth:`ChaosRegistry.fail_scale_up`)
+``fleet.scale_down``        execution count (1-based): the Nth replica
+                            retirement (``FleetRouter.remove_replica``),
+                            consulted AFTER the victim's in-flight work
+                            failed over. ``error`` models the victim
+                            CRASHING MID-DRAIN: the clean evacuation never
+                            runs (a dead process frees its memory by
+                            dying), the failure is charged, and the
+                            removal still completes — the failed-over
+                            work is already safe on survivors
+                            (:meth:`ChaosRegistry.crash_scale_down`)
 ==========================  =============================================
 
 Fault kinds: ``"error"`` (the site raises — or records — an exception),
@@ -196,6 +214,24 @@ class ChaosRegistry:
         if after_tokens < 1:
             raise ValueError(f"after_tokens must be >= 1, got {after_tokens}")
         return self.add(f"gateway.disconnect.{stream_id}", "error", after_tokens)
+
+    def fail_scale_up(self, attempt: int, *, count: int = 1,
+                      exc_factory=None) -> Fault:
+        """Fail the fleet's ``attempt``-th replica spawn (1-based) — the
+        scale-up chaos drill (docs/serving.md "Elasticity"): the factory's
+        process never comes up, ``fleet_scale_up_failed_total`` counts it,
+        and the autoscaler holds its cooldown before retrying."""
+        return self.add("fleet.scale_up", "error", attempt, count=count,
+                        exc_factory=exc_factory)
+
+    def crash_scale_down(self, attempt: int, *, count: int = 1,
+                         exc_factory=None) -> Fault:
+        """Crash the victim of the fleet's ``attempt``-th scale-down
+        (1-based) MID-DRAIN — after its in-flight work failed over, before
+        the clean evacuation: the removal completes anyway and no accepted
+        request is lost (the drill's pin)."""
+        return self.add("fleet.scale_down", "error", attempt, count=count,
+                        exc_factory=exc_factory)
 
     def fail_dispatch(self, attempt: int, *, count: int = 1) -> Fault:
         """Fail the router's ``attempt``-th dispatch attempt (1-based,

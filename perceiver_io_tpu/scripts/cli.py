@@ -176,6 +176,40 @@ class HttpArgs:
 
 
 @dataclasses.dataclass
+class AutoscaleArgs:
+    """``--serve.autoscale.*``: SLO-driven fleet elasticity
+    (docs/serving.md "Elasticity"). Setting ``--serve.autoscale.max``
+    attaches a :class:`~perceiver_io_tpu.serving.FleetAutoscaler` to the
+    fleet router (built even at ``--serve.replicas=1``): sustained SLO burn
+    (``--obs.slo.*``) or queue pressure scales replicas up to ``max``
+    through the degradation ladder; recovery scales back down to ``min``
+    with zero dropped in-flight requests (exactly-once failover replay,
+    pool pages returned tagged ``scale_down``). Off unless ``max`` set."""
+
+    #: replica ceiling; setting it enables the autoscaler
+    max: Optional[int] = None
+    #: replica floor — scale-down never goes below it
+    min: int = 1
+    #: hysteresis: per-direction cooldowns (seconds, on the fleet clock);
+    #: the down cooldown gates on the last scale action in EITHER direction
+    up_cooldown_s: float = 15.0
+    down_cooldown_s: float = 60.0
+    #: consecutive control-loop polls of fresh evidence before acting
+    up_evidence: int = 2
+    down_evidence: int = 5
+    #: queue-depth watermarks as multiples of total healthy slot capacity:
+    #: depth above high x capacity is a scale-up trigger (even without SLO
+    #: targets); depth must fall below low x capacity to count as
+    #: scale-down evidence
+    queue_high: float = 1.0
+    queue_low: float = 0.25
+    #: slot count for replicas spawned on the scale-up path (slots engine
+    #: only) — applied via the warm-cache resize_slots rebuild before the
+    #: replica takes traffic
+    scale_up_slots: Optional[int] = None
+
+
+@dataclasses.dataclass
 class ServeArgs:
     """``--serve.*`` flags for the ``serve`` subcommand: bucketed text
     generation over a ``save_pretrained`` checkpoint (docs/serving.md)."""
@@ -268,6 +302,9 @@ class ServeArgs:
     #: the ``--serve.http.*`` sub-group: the async HTTP/SSE streaming
     #: gateway (docs/serving.md "Streaming"); off unless ``http.port`` set
     http: HttpArgs = dataclasses.field(default_factory=HttpArgs)
+    #: the ``--serve.autoscale.*`` sub-group: SLO-driven fleet elasticity
+    #: (docs/serving.md "Elasticity"); off unless ``autoscale.max`` set
+    autoscale: AutoscaleArgs = dataclasses.field(default_factory=AutoscaleArgs)
 
 
 def _serve_decode_mode(flag_value: str) -> str:
@@ -885,7 +922,32 @@ class CLI:
                 raise SystemExit(
                     f"--serve.replicas must be >= 1, got {args.replicas}"
                 )
-            fleet_mode = args.replicas > 1
+            autoscale = args.autoscale
+            if autoscale.max is None and any(
+                k.startswith("serve.autoscale.") for k in values
+            ):
+                # inapplicable-flag convention: tuning an autoscaler that
+                # was never enabled must not silently do nothing
+                raise SystemExit(
+                    "--serve.autoscale.* tunes the fleet autoscaler, which "
+                    "is enabled by setting --serve.autoscale.max"
+                )
+            if autoscale.max is not None:
+                if autoscale.max < max(autoscale.min, args.replicas):
+                    raise SystemExit(
+                        f"--serve.autoscale.max ({autoscale.max}) must be >= "
+                        f"max(--serve.autoscale.min ({autoscale.min}), "
+                        f"--serve.replicas ({args.replicas}))"
+                    )
+                if autoscale.scale_up_slots is not None and args.engine != "slots":
+                    raise SystemExit(
+                        "--serve.autoscale.scale_up_slots applies to "
+                        "--serve.engine=slots (the bucket engine has no "
+                        "persistent decode slots to resize)"
+                    )
+            # the autoscaler drives FleetRouter.add/remove_replica, so
+            # enabling it builds the fleet layer even at one replica
+            fleet_mode = args.replicas > 1 or autoscale.max is not None
             if not fleet_mode:
                 # inapplicable-flag convention (same as --serve.prefill_chunk
                 # with the bucket engine): asking for fleet supervision
@@ -985,6 +1047,24 @@ class CLI:
                     slo_monitor=kit["slo_monitor"],
                     slo_shed_factor=obs.slo.shed_factor,
                 )
+                if autoscale.max is not None:
+                    from perceiver_io_tpu.serving import FleetAutoscaler
+
+                    # ctor installs itself on the router; every fleet
+                    # step() polls it (docs/serving.md "Elasticity")
+                    FleetAutoscaler(
+                        engine,
+                        max_replicas=autoscale.max,
+                        min_replicas=autoscale.min,
+                        up_cooldown_s=autoscale.up_cooldown_s,
+                        down_cooldown_s=autoscale.down_cooldown_s,
+                        up_evidence=autoscale.up_evidence,
+                        down_evidence=autoscale.down_evidence,
+                        queue_high=autoscale.queue_high,
+                        queue_low=autoscale.queue_low,
+                        scale_up_slots=autoscale.scale_up_slots,
+                        tracer=tracer,
+                    )
             else:
                 engine = make_engine()
                 if kit["slo_monitor"] is not None:
@@ -1227,6 +1307,13 @@ class CLI:
               "--serve.max_queue --serve.deadline_s "
               "--serve.replicas=<n> --serve.failover={true|false} "
               "--serve.step_timeout_s=<s>")
+        print("serve autoscale: --serve.autoscale.max=<n> --serve.autoscale.min "
+              "--serve.autoscale.up_cooldown_s --serve.autoscale.down_cooldown_s "
+              "--serve.autoscale.up_evidence --serve.autoscale.down_evidence "
+              "--serve.autoscale.queue_high --serve.autoscale.queue_low "
+              "--serve.autoscale.scale_up_slots — SLO-driven fleet elasticity: "
+              "burn/queue pressure scales replicas up to max, cooldown-gated "
+              "zero-downtime scale-down (docs/serving.md)")
         print("serve http gateway: --serve.http.port=<n|0> --serve.http.host "
               "--serve.http.stream={sse|jsonl} --serve.http.max_streams — "
               "POST /v1/generate streams tokens as they decode; GET /healthz, "

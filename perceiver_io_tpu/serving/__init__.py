@@ -13,6 +13,12 @@ generation executors.
   crash/hang failure detection, and exactly-once failover that replays
   in-flight requests from their prompts (token-identical under greedy
   decoding).
+- :class:`FleetAutoscaler` — the SLO-driven elasticity closed loop
+  (docs/serving.md "Elasticity"): burn-rate breaches and queue pressure
+  drive the fleet's replica count between min/max bounds through an
+  ordered degradation ladder, with zero-downtime scale-down (in-flight
+  work replays exactly-once on survivors, pool pages return tagged
+  ``scale_down``).
 - :class:`StreamingGateway` — the stdlib-only asyncio HTTP/1.1 front
   end: per-token SSE / JSON-lines streaming out of ``step()``,
   socket-anchored TTFT, and client-disconnect cancellation that frees
@@ -24,6 +30,7 @@ isolation, graceful ``drain()``, and a ``health()`` readiness snapshot
 sharing one schema (:data:`~perceiver_io_tpu.serving.engine.HEALTH_KEYS`).
 """
 from perceiver_io_tpu.reliability import QueueFull
+from perceiver_io_tpu.serving.autoscaler import LADDER, FleetAutoscaler
 from perceiver_io_tpu.serving.buckets import BucketTable
 from perceiver_io_tpu.serving.engine import HEALTH_KEYS, ServeRequest, ServingEngine
 from perceiver_io_tpu.serving.fleet import (
@@ -39,8 +46,10 @@ from perceiver_io_tpu.serving.slots import SlotServingEngine
 __all__ = [
     "BucketTable",
     "CircuitBreaker",
+    "FleetAutoscaler",
     "FleetRequest",
     "FleetRouter",
+    "LADDER",
     "HEALTH_KEYS",
     "KVPagePool",
     "PrefixBlockIndex",

@@ -495,6 +495,29 @@ class ServingEngine:
                 return True
         return False
 
+    def evacuate(self, cause: str = "scale_down") -> int:
+        """Withdraw EVERY live request at once — the fleet scale-down path
+        (docs/serving.md "Elasticity"): the router has already failed this
+        engine's work over to survivors, so the local copies are stale and
+        must be retired immediately rather than decoded to completion.
+        Each finishes ``cancelled`` with one terminal span (the ``cause``
+        attribute separates a scale-down evacuation from a client
+        disconnect in the events stream). The bucket engine only holds
+        queued work between steps; the slot engine overrides this to also
+        retire residents and return their KV pool pages tagged ``cause``.
+        Returns the number of requests evacuated."""
+        evacuated = 0
+        queued, self._queue = list(self._queue), []
+        for req in queued:
+            if self.tracer is not None:
+                self.tracer.event(
+                    "serving.cancelled", trace_id=req.trace_id,
+                    stage="queued", tokens_emitted=0, cause=cause,
+                )
+            self._finish(req, "cancelled", error=f"evacuated ({cause})")
+            evacuated += 1
+        return evacuated
+
     # -- fault disposition ---------------------------------------------------
     def _finish(self, req: ServeRequest, status: str, *, error: Optional[str] = None) -> None:
         req.status = status

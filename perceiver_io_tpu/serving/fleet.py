@@ -51,6 +51,21 @@ load-aware policy, and supervises them:
   in-flight work; ``rolling_restart()`` cycles replicas one at a time
   (drain one, rebuild it from the factory, reintegrate) while the rest
   keep serving.
+- **Elasticity** (docs/serving.md "Elasticity") — the replica set is no
+  longer fixed for the life of the process: :meth:`FleetRouter.add_replica`
+  spawns a replica from the engine factory (process-global executor
+  caches mean it compiles nothing after the first warmup) and
+  :meth:`FleetRouter.remove_replica` retires one with ZERO dropped
+  in-flight requests — its live dispatches fail over through the
+  exactly-once replay path (token-identical under greedy decoding) and
+  its engine is evacuated so every KV pool page returns tagged
+  ``cause="scale_down"``. Both transitions are chaos-scriptable
+  (``fleet.scale_up`` spawn failure / ``fleet.scale_down`` crash
+  mid-drain) and driven in production by the
+  :class:`~perceiver_io_tpu.serving.autoscaler.FleetAutoscaler` closed
+  loop, polled once per :meth:`FleetRouter.step`. All per-replica
+  bookkeeping keys by ``replica_id`` (never by position), so replicas
+  appearing and disappearing mid-run cannot corrupt attribution.
 
 The router mirrors the engines' request surface — ``submit`` / ``serve``
 / ``step`` / ``pending`` / ``run_until_idle`` / ``drain`` / ``warmup`` /
@@ -103,6 +118,9 @@ FLEET_COUNTERS = (
     "fleet_replica_restarts_total",
     "fleet_duplicate_results_total",
     "fleet_slo_shed_total",
+    "fleet_scale_up_total",
+    "fleet_scale_down_total",
+    "fleet_scale_up_failed_total",
 )
 
 
@@ -396,17 +414,22 @@ class FleetRouter:
         self.slo_monitor = slo_monitor
         self.slo_shed_factor = float(slo_shed_factor)
         self._rng = random.Random(redispatch_seed)
-        self._replicas = [
-            Replica(
-                f, i, clock=clock, chaos=chaos,
-                breaker=CircuitBreaker(
-                    failure_threshold=breaker_threshold,
-                    cooldown_s=breaker_cooldown_s, clock=clock,
-                ),
-                latency_mirror=self._mirror_token_latency,
-            )
-            for i, f in enumerate(factories)
-        ]
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        #: the factory scale-up spawns from when none is passed explicitly
+        #: (a homogeneous fleet's one factory)
+        self._default_factory = factories[0]
+        #: replicas keyed by replica_id — NEVER by list position: ids are
+        #: handed out monotonically and survive removals, so per-replica
+        #: bookkeeping (dispatch maps, completion attribution, chaos sites)
+        #: stays correct while the autoscaler adds/retires replicas mid-run
+        self._replicas: Dict[int, Replica] = {}
+        self._next_replica_id = 0
+        #: optional :class:`~perceiver_io_tpu.serving.autoscaler.FleetAutoscaler`
+        #: polled once per :meth:`step` (the autoscaler's ctor installs it)
+        self.autoscaler = None
+        for f in factories:
+            self._spawn_replica(f)
         if slo_monitor is not None:
             # error-rate dimension: fed from the fleet's own disposition
             # counters, diffed per poll — the router never sees engine
@@ -424,14 +447,33 @@ class FleetRouter:
         self._accepting = True
         self._last_step_activity = False
         self._completed_by_replica: Dict[int, int] = {
-            r.replica_id: 0 for r in self._replicas
+            r.replica_id: 0 for r in self._replicas.values()
         }
         self.registry.declare_counters(*FLEET_COUNTERS)
         self._update_gauges()
 
     @property
     def replicas(self) -> List[Replica]:
-        return list(self._replicas)
+        """Live replicas in ``replica_id`` order (ids are monotonic, so
+        this is also spawn order)."""
+        return [self._replicas[rid] for rid in sorted(self._replicas)]
+
+    def _spawn_replica(self, factory: Callable[[], object]) -> Replica:
+        """Build one replica on the next monotonic id (ids are never
+        reused: a chaos script or span keyed ``fleet.replica_step.<r>``
+        must stay unambiguous across scale churn)."""
+        rid = self._next_replica_id
+        self._next_replica_id += 1
+        replica = Replica(
+            factory, rid, clock=self._clock, chaos=self._chaos,
+            breaker=CircuitBreaker(
+                failure_threshold=self._breaker_threshold,
+                cooldown_s=self._breaker_cooldown_s, clock=self._clock,
+            ),
+            latency_mirror=self._mirror_token_latency,
+        )
+        self._replicas[rid] = replica
+        return replica
 
     @property
     def last_step_made_progress(self) -> bool:
@@ -485,7 +527,7 @@ class FleetRouter:
             raise RuntimeError("fleet is draining; new submissions rejected")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         try:
-            self._replicas[0].engine.check_feasible(prompt, config)
+            self.replicas[0].engine.check_feasible(prompt, config)
         except ValueError as e:
             self.registry.inc("fleet_requests_rejected_total")
             e.trace_id = self._terminal_event("rejected", error=str(e))
@@ -566,7 +608,13 @@ class FleetRouter:
         if req is None or req.done:
             return False
         if req.status == "dispatched" and req.replica_id is not None:
-            replica = self._replicas[req.replica_id]
+            replica = self._replicas.get(req.replica_id)
+            if replica is None:
+                # the dispatch target was scaled away; its in-flight work
+                # was already failed over, so the request is queued — just
+                # finalize the withdrawal
+                self._finalize(req, "cancelled", replica_id=None)
+                return True
             handle = replica.handles.get(req.request_id)
             if handle is not None and handle.done:
                 # the engine copy already finished; the next collect sweep
@@ -620,7 +668,7 @@ class FleetRouter:
         Idempotent."""
         self._accepting = False
         served = self.run_until_idle()
-        for replica in self._replicas:
+        for replica in self.replicas:
             if replica.breaker.poll() == "open":
                 continue
             replica.engine.drain()
@@ -631,7 +679,7 @@ class FleetRouter:
         """Warm every replica; the executor caches are process-global, so
         replica 0 compiles the grid and the rest reuse it. Returns total
         fresh compiles."""
-        return sum(r.engine.warmup(config) for r in self._replicas)
+        return sum(r.engine.warmup(config) for r in self.replicas)
 
     # -- internals ----------------------------------------------------------
     def _terminal_event(self, status: str, **attrs) -> Optional[str]:
@@ -642,9 +690,13 @@ class FleetRouter:
         return trace_id
 
     def _update_gauges(self) -> None:
-        healthy = sum(1 for r in self._replicas if r.breaker.state == "closed")
+        replicas = self._replicas.values()
+        healthy = sum(1 for r in replicas if r.breaker.state == "closed")
         self.registry.set_gauge("fleet_replicas_healthy", healthy)
         self.registry.set_gauge("fleet_replicas", len(self._replicas))
+        self.registry.set_gauge(
+            "fleet_replicas_draining", sum(1 for r in replicas if r.draining)
+        )
 
     def _finalize(self, req: FleetRequest, status: str, *,
                   result: Optional[np.ndarray] = None,
@@ -728,18 +780,29 @@ class FleetRouter:
         return opened
 
     def _requeue(self, req: FleetRequest, error: str, *,
-                 avoid_replica_id: Optional[int] = None) -> int:
+                 avoid_replica_id: Optional[int] = None,
+                 voluntary: bool = False) -> int:
         """Failover path: return the request to the fleet queue for
         re-dispatch (replayed from its prompt), or fail it terminally when
         its dispatch budget (``1 + redispatch_policy.max_retries``) is
         spent. ``avoid_replica_id`` records where the failed attempt ran so
-        the next dispatch prefers anywhere else. Returns 1 when this call
-        disposed of the request."""
+        the next dispatch prefers anywhere else. ``voluntary`` marks a
+        retirement requeue (scale-down): the withdrawn dispatch did not
+        fail, so it is REFUNDED — no budget charge, no terminal
+        budget-exhaustion, no backoff delay — a replica retiring must not
+        be able to drop a request whose genuine failovers already spent
+        the budget. Returns 1 when this call disposed of the request."""
         self._dispatched.pop(req.request_id, None)
         req.status = "queued"
         req.replica_id = None
         if avoid_replica_id is not None:
             req.last_replica_id = avoid_replica_id
+        if voluntary:
+            req.dispatches = max(0, req.dispatches - 1)
+            self.registry.inc("fleet_redispatch_total")
+            req.not_before = self._clock()
+            self._queue.append(req)
+            return 0
         if req.dispatches >= 1 + self.redispatch_policy.max_retries:
             self._finalize(
                 req, "failed",
@@ -813,7 +876,7 @@ class FleetRouter:
         pending = self._queue
         self._queue = []
         loads: Dict[Replica, int] = {}
-        for replica in self._replicas:
+        for replica in self.replicas:
             h = replica.engine.health()
             if h["ready"]:
                 loads[replica] = (
@@ -1041,10 +1104,17 @@ class FleetRouter:
             # transitions (and the admission tightening they gate) happen
             # here, on the shared clock, never mid-submit
             self.slo_monitor.poll()
+        if self.autoscaler is not None:
+            # the elasticity control loop runs HERE, before the pass
+            # snapshots the replica set: a scale-up serves this very pass,
+            # a scale-down's failed-over work re-dispatches below
+            self.autoscaler.poll()
         disposed = self._expire_overdue()
         disposed += self._dispatch_pending()
         stepped_any = False
-        for replica in self._replicas:
+        # snapshot: an autoscaler poll (above) may have added/removed
+        # replicas, and the next poll can again — never iterate the live map
+        for replica in self.replicas:
             state = replica.breaker.poll()
             if state == "open":
                 continue
@@ -1090,6 +1160,156 @@ class FleetRouter:
         self._update_gauges()
         return disposed
 
+    # -- elasticity ---------------------------------------------------------
+    def add_replica(self, factory: Optional[Callable[[], object]] = None
+                    ) -> Replica:
+        """Scale up by one replica, spawned from ``factory`` (default: the
+        fleet's first constructor factory — the homogeneous case). The
+        executor caches are process-global, so after one warmup pass a new
+        replica compiles nothing and serves its first dispatch immediately.
+
+        The ``fleet.scale_up`` chaos site fires first (execution-count
+        keyed): an ``error`` fault models a SPAWN FAILURE — the factory's
+        process never comes up — counted ``fleet_scale_up_failed_total``
+        and re-raised for the caller (the autoscaler absorbs it and holds
+        its cooldown, so a broken image cannot spin the control loop)."""
+        if self._chaos is not None:
+            fault = self._chaos.hit("fleet.scale_up")
+            if fault is not None and fault.kind == "error":
+                self.registry.inc("fleet_scale_up_failed_total")
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "autoscaler.spawn_failed",
+                        error=str(fault.make_error()),
+                        replicas=len(self._replicas),
+                    )
+                raise fault.make_error()
+        replica = self._spawn_replica(
+            factory if factory is not None else self._default_factory
+        )
+        self._completed_by_replica.setdefault(replica.replica_id, 0)
+        self.registry.inc("fleet_scale_up_total")
+        self._update_gauges()
+        return replica
+
+    def scale_down_victim(self) -> Optional[Replica]:
+        """The replica :meth:`remove_replica` should retire next: the
+        LEAST-LOADED eligible one (ties → highest id, so the founding
+        replicas persist). Excluded: draining replicas, the last healthy
+        replica (never drop below min-healthy — ``healthz`` must stay
+        ready), and any replica whose breaker is not closed while it still
+        holds engine handles — its in-flight work was re-queued at
+        failover, and the stale copies must be left to retire through the
+        duplicate-dedupe sweep, not evacuated into accounting limbo.
+        Returns None when nothing is eligible."""
+        replicas = list(self._replicas.values())
+        healthy = [
+            r for r in replicas if r.breaker.state == "closed" and not r.draining
+        ]
+        best = None
+        best_key = None
+        for replica in replicas:
+            if replica.draining:
+                continue
+            if replica.breaker.state != "closed" and replica.handles:
+                continue
+            if replica in healthy and len(healthy) <= 1:
+                continue  # the last healthy replica keeps the fleet ready
+            load = len(replica.handles)
+            try:
+                h = replica.engine.health()
+                load += int(h["queue_depth"]) + int(h.get("slots_active") or 0)
+            except Exception:
+                pass  # a wedged engine is still a fine victim
+            key = (load, -replica.replica_id)
+            if best_key is None or key < best_key:
+                best, best_key = replica, key
+        return best
+
+    def remove_replica(self, replica_id: int) -> Replica:
+        """Scale down by one replica with ZERO dropped in-flight requests
+        — the rolling_restart() discipline applied to retirement:
+
+        1. the replica stops receiving dispatches (``draining``),
+        2. its live in-flight requests fail over through the exactly-once
+           replay path — survivors replay them from their prompts,
+           token-identical under greedy decoding,
+        3. its engine is **evacuated**: every stale engine-side copy is
+           withdrawn and every KV pool page (mapped + reserved) returns to
+           the pool tagged ``cause="scale_down"`` — the zero-leak
+           accounting the acceptance drill pins,
+        4. the replica leaves the fleet; its id is never reused.
+
+        The ``fleet.scale_down`` chaos site fires after the failover
+        (execution-count keyed): an ``error`` fault models the replica
+        CRASHING MID-DRAIN — the evacuation never runs (a dead process
+        frees its memory by dying), the failure is charged, and the
+        removal completes; the failed-over work is already safe.
+
+        Returns the removed :class:`Replica` (its engine still inspectable
+        — tests read the pool's ``frees_by_cause``). Refuses to remove the
+        last healthy non-draining replica (``healthz`` stays ready
+        throughout, never below min-healthy)."""
+        replica = self._replicas.get(replica_id)
+        if replica is None:
+            raise KeyError(f"no replica {replica_id} in the fleet")
+        others_healthy = sum(
+            1 for r in self._replicas.values()
+            if r.replica_id != replica_id
+            and r.breaker.state == "closed" and not r.draining
+        )
+        if others_healthy == 0 and replica.breaker.state == "closed":
+            raise ValueError(
+                f"removing replica {replica_id} would leave no healthy "
+                "replica — the fleet must stay ready through a scale-down "
+                "(scale_down_victim() never picks this one)"
+            )
+        replica.draining = True
+        # always replay — even with failover=False: scale-down is a
+        # voluntary retirement, not a failure, so its in-flight work moves
+        # to survivors through the same exactly-once requeue path
+        victims = sorted(
+            (
+                self._dispatched[fid]
+                for fid in list(replica.handles)
+                if fid in self._dispatched
+                and self._dispatched[fid].replica_id == replica.replica_id
+            ),
+            key=lambda r: r.request_id,
+        )
+        if victims:
+            self.registry.inc("fleet_failover_total")
+            for req in victims:
+                self._requeue(
+                    req, f"replica {replica_id} retiring (fleet scale-down)",
+                    avoid_replica_id=replica_id, voluntary=True,
+                )
+        crashed = None
+        if self._chaos is not None:
+            crashed = self._chaos.hit("fleet.scale_down")
+            if crashed is not None and crashed.kind != "error":
+                crashed = None
+        if crashed is not None:
+            # crash mid-drain: the process died before a clean evacuation;
+            # its in-flight work is already re-queued above, so the drill
+            # only costs the failure accounting
+            self.registry.inc("fleet_replica_failures_total")
+            if self.tracer is not None:
+                self.tracer.event(
+                    "fleet.replica_failed", replica=replica_id,
+                    reason="scale_down_crash",
+                    error=str(crashed.make_error()), in_flight=0,
+                )
+        else:
+            evacuate = getattr(replica.engine, "evacuate", None)
+            if evacuate is not None:
+                evacuate(cause="scale_down")
+        replica.handles.clear()
+        del self._replicas[replica_id]
+        self.registry.inc("fleet_scale_down_total")
+        self._update_gauges()
+        return replica
+
     # -- operations ---------------------------------------------------------
     def rolling_restart(self) -> int:
         """Zero-downtime maintenance: one replica at a time — stop
@@ -1098,7 +1318,7 @@ class FleetRouter:
         factory, reintegrate. An open (already failed) replica is rebuilt
         immediately. Returns the number of replicas restarted."""
         restarted = 0
-        for replica in self._replicas:
+        for replica in self.replicas:
             replica.draining = True
             restarts_before = replica.restarts
             try:
@@ -1129,7 +1349,7 @@ class FleetRouter:
         """Summed per-replica prefix-cache hit accounting, or None when no
         replica shares prefixes (docs/serving.md "Prefix sharing")."""
         regs: dict = {}
-        for r in self._replicas:
+        for r in self.replicas:
             if getattr(r.engine, "_prefix_index", None) is not None:
                 regs[id(r.engine.registry)] = r.engine.registry
         if not regs:
@@ -1177,7 +1397,13 @@ class FleetRouter:
             "replica_restarts": c("fleet_replica_restarts_total"),
             "duplicate_results_ignored": c("fleet_duplicate_results_total"),
             "replicas_healthy": sum(
-                1 for r in self._replicas if r.breaker.state == "closed"
+                1 for r in self._replicas.values() if r.breaker.state == "closed"
+            ),
+            "scale_ups": c("fleet_scale_up_total"),
+            "scale_downs": c("fleet_scale_down_total"),
+            "scale_up_failures": c("fleet_scale_up_failed_total"),
+            "autoscaler": (
+                None if self.autoscaler is None else self.autoscaler.stats()
             ),
             "completed_by_replica": {
                 str(k): v for k, v in sorted(self._completed_by_replica.items())
@@ -1213,7 +1439,7 @@ class FleetRouter:
                     "in_flight": len(r.handles),
                     "engine": r.engine.stats(),
                 }
-                for r in self._replicas
+                for r in self.replicas
             ],
         })
         return out
@@ -1222,11 +1448,19 @@ class FleetRouter:
         """Fleet readiness under the shared health schema
         (``serving.engine.HEALTH_KEYS``) plus per-replica snapshots —
         ``ready`` means a submission would be accepted right now AND at
-        least one replica's breaker is closed to run it."""
+        least one replica's breaker is closed to run it.
+
+        ``replicas`` / ``replicas_healthy`` / ``draining`` are COUNTS (the
+        ``/healthz`` payload a load balancer or autoscaler dashboard reads
+        — docs/serving.md "Elasticity"); the per-replica snapshots live
+        under ``replica_detail``. ``ready`` is pinned to stay true across
+        a rolling restart and an autoscale transition: survivors keep
+        serving while one replica drains."""
         now = self._clock()
         depth = len(self._queue) + len(self._dispatched)
         reg = self.registry
-        healthy = sum(1 for r in self._replicas if r.breaker.state == "closed")
+        replicas = list(self._replicas.values())
+        healthy = sum(1 for r in replicas if r.breaker.state == "closed")
         # admission as currently ENFORCED — under SLO tightening, "ready"
         # flips false at the reduced bound, so a well-behaved front end
         # backs off before tripping the shed counter
@@ -1245,6 +1479,8 @@ class FleetRouter:
             "timed_out": int(reg.counter("fleet_requests_timed_out_total")),
             "failed": int(reg.counter("fleet_requests_failed_total")),
             "cancelled": int(reg.counter("fleet_requests_cancelled_total")),
+            "replicas": len(replicas),
             "replicas_healthy": healthy,
-            "replicas": [r.health() for r in self._replicas],
+            "draining": sum(1 for r in replicas if r.draining),
+            "replica_detail": [r.health() for r in self.replicas],
         }

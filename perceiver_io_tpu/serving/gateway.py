@@ -408,6 +408,17 @@ class StreamingGateway:
                 )
             elif path == "/healthz" and method == "GET":
                 health = self.engine.health()
+                # uniform LB payload (docs/serving.md "Elasticity"): a
+                # fleet reports its real replica counts (pinned to stay
+                # 200-ready across rolling restarts and autoscale
+                # transitions); a single engine is its own fleet of one
+                health.setdefault("replicas", 1)
+                health.setdefault(
+                    "replicas_healthy", 1 if health.get("ready") else 0
+                )
+                health.setdefault(
+                    "draining", 0 if health.get("accepting", True) else 1
+                )
                 await self._respond(
                     writer, 200 if health.get("ready") else 503, health
                 )

@@ -382,6 +382,10 @@ class KVPagePool:
             "frees_total": self.frees_total,
             "frees_by_cause": dict(sorted(self.frees_by_cause.items())),
             "utilization": round(self.utilization(), 4),
+            # always 0 unless the allocator is buggy; surfaced here so a
+            # scale-down victim's post-mortem (the autoscaler's `retired`
+            # records) carries its own zero-leak evidence
+            "leaked": self.leaked(),
             # refcounted-sharing accounting (docs/serving.md "Prefix
             # sharing"): blocks referenced beyond their mapping slot,
             # reference totals (mapped occurrences + index retains), and

@@ -1624,7 +1624,8 @@ class SlotServingEngine(ServingEngine):
                 # its EXTENSION blocks — conversation-history growth)
                 self._publish_prefix(req, admit.slot)
 
-    def _retire(self, entry: _Slot, status: str, *, error: Optional[str] = None) -> None:
+    def _retire(self, entry: _Slot, status: str, *, error: Optional[str] = None,
+                kv_cause: Optional[str] = None) -> None:
         if status == "ok":
             pad_id = entry.req.config.pad_token_id
             out = np.full((entry.max_new,), pad_id, np.int32)
@@ -1633,9 +1634,12 @@ class SlotServingEngine(ServingEngine):
         self._finish(entry.req, status, error=error)
         self._slots[entry.slot] = None
         # pool free-cause taxonomy (kv_pool.frees_by_cause): client-driven
-        # reclaim and engine-fault reclaim stay separable from ordinary
+        # reclaim, engine-fault reclaim, and fleet scale-down evacuation
+        # (kv_cause override) stay separable from ordinary
         # EOS/max_new/deadline churn
-        cause = {"cancelled": "cancelled", "failed": "failover"}.get(status, "retire")
+        cause = kv_cause or {
+            "cancelled": "cancelled", "failed": "failover",
+        }.get(status, "retire")
         self._kv_release(entry.slot, cause=cause)
         if self.tracer is not None:
             self.tracer.event(
@@ -1713,6 +1717,81 @@ class SlotServingEngine(ServingEngine):
                 self._update_slot_gauges()
                 return True
         return super().cancel(request_id)
+
+    def evacuate(self, cause: str = "scale_down") -> int:
+        """Withdraw every live request at once — the fleet scale-down path
+        (docs/serving.md "Elasticity"), token-granular: the in-flight
+        chunked admission drops (its staging caches are
+        garbage-by-construction), every RESIDENT slot retires immediately
+        with its pool pages (mapped + reserved) returned tagged ``cause``
+        in the pool's ``frees_by_cause`` accounting — the zero-leak bar the
+        scale-down drill pins — and queued requests leave through the base
+        path. Per-row independence means nothing here could have shifted
+        another engine's tokens; the fleet has already replayed this work
+        on survivors, token-identical under greedy decoding."""
+        evacuated = 0
+        admit = self._admitting
+        if admit is not None:
+            self._admitting = None
+            self._kv_release(admit.slot, cause=cause)
+            if self.tracer is not None:
+                self.tracer.event(
+                    "serving.cancelled", trace_id=admit.req.trace_id,
+                    stage="admitting", slot=admit.slot, tokens_emitted=0,
+                    cause=cause,
+                )
+            self._finish(admit.req, "cancelled", error=f"evacuated ({cause})")
+            evacuated += 1
+        for entry in self._active():
+            if self.tracer is not None:
+                self.tracer.event(
+                    "serving.cancelled", trace_id=entry.req.trace_id,
+                    stage="resident", slot=entry.slot,
+                    tokens_emitted=len(entry.emitted), cause=cause,
+                )
+            self._retire(
+                entry, "cancelled", error=f"evacuated ({cause})",
+                kv_cause=cause,
+            )
+            evacuated += 1
+        self._update_slot_gauges()
+        return evacuated + super().evacuate(cause)
+
+    def resize_slots(self, new_slots: int) -> int:
+        """Grow or shrink the persistent decode state to ``new_slots`` —
+        the autoscaler's slot-count elasticity knob (docs/serving.md
+        "Elasticity"), riding the SAME rebuild-from-warm-cache path a
+        warmup-time kv-layout switch uses: the device state (and, under the
+        paged layout, the pool — re-scaled to the new slot count unless the
+        operator sized it explicitly) is rebuilt blank via
+        ``_init_kv_state``, while the executor caches are process-global —
+        a slot count this process has compiled before costs ZERO fresh
+        compiles, an unseen one compiles exactly the slot-specialized
+        executors (decode pair + chunk/shared variants). Requires an idle
+        engine (no residents, no in-flight admission) — resizing under
+        traffic would decode residents from zeroed caches; drain or
+        evacuate first. Queued requests survive (host-side numpy, no device
+        state). Returns the previous slot count."""
+        if new_slots < 1:
+            raise ValueError(f"slots must be >= 1, got {new_slots}")
+        if any(s is not None for s in self._slots) or self._admitting is not None:
+            raise RuntimeError(
+                "resize_slots() with requests resident in slots would "
+                "corrupt their decode state; drain() or evacuate() first"
+            )
+        old = self.slots
+        if new_slots == old:
+            return old
+        self.slots = int(new_slots)
+        self._slots = [None] * self.slots
+        if not self._kv_sized:
+            # default pool sizing tracks the slot count (dense-equivalent
+            # capacity); an operator-sized pool is a fixed HBM budget and
+            # must not silently change under a resize
+            self.kv_blocks = self.slots * self._pages_per_slot()
+        self._init_kv_state(self.kv_layout)
+        self._update_slot_gauges()
+        return old
 
     # -- the token-level scheduler ------------------------------------------
     def step(self) -> int:

@@ -78,6 +78,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "elasticity: SLO-driven fleet-elasticity test (burn-rate autoscaler "
+        "ladder, zero-downtime scale-down with exactly-once replay, spike "
+        "loadgen; serving/autoscaler.py, serving/fleet.py; docs/serving.md "
+        "\"Elasticity\"); CPU-fast, runs in the tier-1 suite with a tight "
+        "per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "gateway: HTTP/SSE streaming-gateway test (per-token streaming over "
         "real sockets, client-disconnect cancellation, socket-anchored TTFT; "
         "serving/gateway.py, docs/serving.md); CPU-fast, runs in the tier-1 "

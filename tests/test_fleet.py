@@ -126,9 +126,14 @@ def test_health_schema_contract(tiny_model):
     for key in ("replica_id", "breaker", "consecutive_failures", "in_flight",
                 "restarts"):
         assert key in rep
-    # and the fleet embeds per-replica snapshots under the same contract
-    for per in fleet.health()["replicas"]:
+    # and the fleet embeds per-replica snapshots under the same contract,
+    # plus the elasticity counts the /healthz payload reads
+    fleet_health = fleet.health()
+    for per in fleet_health["replica_detail"]:
         assert HEALTH_KEYS <= set(per)
+    assert fleet_health["replicas"] == 1
+    assert fleet_health["replicas_healthy"] == 1
+    assert fleet_health["draining"] == 0
 
 
 # -- satellite: retry jitter -----------------------------------------------

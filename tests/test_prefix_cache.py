@@ -482,7 +482,7 @@ def test_fleet_failover_replay_rehits_survivor_cache(tiny_model):
     assert s["prefix_cache"] is not None
     assert s["prefix_cache"]["hits"] > 0
     assert s["prefix_cache"]["hits"] + s["prefix_cache"]["misses"] >= len(prompts)
-    for r in fleet._replicas:
+    for r in fleet.replicas:
         assert r.engine._pool.leaked() == 0
 
 
