@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench prefix-cache prefix-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench prefix-cache prefix-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -53,6 +53,15 @@ obs:
 obs-report:
 	$(PY) -m perceiver_io_tpu.observability.report tests/fixtures/events.jsonl \
 		--snapshot tests/fixtures/metrics_snapshot.json
+
+# incident flight-recorder suite (docs/observability.md "Flight recorder
+# & incident bundles"): trace-sampling determinism + tail-keep, triggered
+# bundle drills (cooldown/budget), the FakeClock chaos acceptance drill,
+# and the `obs incident` analyzer — then the analyzer over the checked-in
+# fixture bundle. CPU-fast, also tier-1.
+incident:
+	$(PY) -m pytest tests/test_flight_recorder.py -q -m flight_recorder
+	$(PY) -m perceiver_io_tpu.observability.report --incident tests/fixtures/incident
 
 # SLO telemetry suite (docs/observability.md): burn-rate monitor drills,
 # load-generator determinism, TTFT/ITL accounting, fleet admission

@@ -666,6 +666,25 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "streaming": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: incident flight-recorder chaos drill ----
+        if left() > 60.0:
+            log("run: incident probe (replica crash during SLO breach -> "
+                "bundle -> analyzer joins)")
+            try:
+                inc = _bench_incident(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "incident": inc})
+                log(f"run: incident bundles={inc['bundles']} "
+                    f"(kinds={inc['bundle_kinds']}, suppressed="
+                    f"{inc['suppressed']}), trace_join={inc['trace_join']}, "
+                    f"decomposition_exact={inc['decomposition_exact']}, "
+                    f"nonok_traces_kept={inc['nonok_traces_kept']} at "
+                    f"{inc['sample_rate']} sampling (span accounting closed="
+                    f"{inc['span_accounting_closed']})")
+            except Exception as e:
+                log(f"run: incident probe failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "incident": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # BENCH_* records carry the process-wide telemetry snapshot AND the
         # device-cost ledger (per-executor compile/memory/retrace table;
         # docs/observability.md) — every BENCH_* file is `obs report`-able.
@@ -2191,6 +2210,167 @@ def _bench_streaming(model, params, cfg, *, slots: int = 4, n_requests: int = 10
             "frees_by_cause": dict(sorted(pool.frees_by_cause.items())),
             "high_water": pool.high_water,
         },
+    }
+
+
+def _bench_incident(model, params, cfg, *, n_requests: int = 4,
+                    new_tokens: int = 4, sample_rate: float = 0.1):
+    """Incident flight-recorder chaos drill (docs/observability.md "Flight
+    recorder & incident bundles"), deterministic under
+    :class:`~perceiver_io_tpu.reliability.FakeClock`: a healthy warm-up
+    cohort, then a latency fault (requests age past the TTFT target) with
+    a scripted replica crash mid-decode — the SLO breach and the replica
+    failure each dump exactly one bounded atomic bundle (per-kind
+    cooldown), and the ``obs incident`` analyzer is run over the post-run
+    capture to pin the joins:
+
+    - **trace_join** — every trace id the crash bundle names appears in
+      the (10%-sampled) events.jsonl, because non-ok terminals are always
+      tail-kept;
+    - **decomposition_exact** — the analyzer's per-request TTFT
+      components telescope to the registry's recorded ``serving_ttft_ms``
+      with zero unattributed residue, and the worst decomposed request
+      matches the registry max exactly;
+    - **nonok_traces_kept** — 100% of non-ok terminal traces reached disk
+      despite head sampling, with kept + sampled_out == total closing the
+      span accounting.
+    """
+    import json as _json
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.observability import (
+        FlightRecorder,
+        JsonlSpanSink,
+        MetricsRegistry,
+        SamplingSpanSink,
+        SLOMonitor,
+        SLOPolicy,
+        Tracer,
+        read_events_jsonl,
+    )
+    from perceiver_io_tpu.observability import report as report_mod
+    from perceiver_io_tpu.observability.tracing import TAIL_KEEP_STATUSES
+    from perceiver_io_tpu.reliability import ChaosRegistry, FakeClock, RetryPolicy
+    from perceiver_io_tpu.serving import BucketTable, FleetRouter, SlotServingEngine
+
+    num_latents = min(4, cfg.max_latents)
+    max_len = min(
+        8, cfg.max_seq_len - new_tokens,
+        cfg.max_seq_len - cfg.max_latents + num_latents,
+    )
+    table = BucketTable(prompt_lens=(max_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    root = tempfile.mkdtemp(prefix="bench-incident-")
+    events_path = os.path.join(root, "events.jsonl")
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    sampler = SamplingSpanSink(
+        JsonlSpanSink(events_path), rate=sample_rate, registry=reg
+    )
+    tracer = Tracer(clock=clock, sink=sampler)
+    recorder = FlightRecorder(
+        os.path.join(root, "incidents"), tracer=tracer, registry=reg,
+        clock=clock, cooldown_s=3600.0, max_bundles=8, keep_spans=256,
+        snapshot_every_s=0.5,
+    )
+    monitor = SLOMonitor(
+        SLOPolicy(ttft_p95_ms=50.0), clock=clock, registry=reg,
+        tracer=tracer, flight_recorder=recorder,
+        fast_window_s=5.0, slow_window_s=20.0, min_samples=3,
+    )
+    chaos = ChaosRegistry()
+
+    def factory():
+        return SlotServingEngine(
+            model, params, gcfg, table, slots=2, clock=clock, tracer=tracer,
+            rng=jax.random.PRNGKey(3),
+        )
+
+    fleet = FleetRouter(
+        [factory] * 2, clock=clock, registry=reg, tracer=tracer,
+        chaos=chaos, slo_monitor=monitor, flight_recorder=recorder,
+        # no redispatch budget: crash victims fail terminally, so their
+        # non-ok traces are tail-kept on disk — the join evidence
+        redispatch_policy=RetryPolicy(max_retries=0, backoff_base_s=0.0),
+    )
+    recorder.add_source("health", fleet.health)
+    rng = np.random.default_rng(11)
+
+    def prompt():
+        return rng.integers(1, cfg.vocab_size, size=max_len).astype(np.int32)
+
+    def drain():
+        while fleet.pending():
+            fleet.step()
+            recorder.maybe_record()
+            clock.advance(0.01)
+        fleet.step()
+
+    for _ in range(n_requests):  # healthy warm-up: the "before" evidence
+        fleet.submit(prompt())
+    drain()
+    # the incident: the cohort ages past the TTFT target while replica 0's
+    # 2nd upcoming supervised step carries a scripted crash (mid-decode)
+    steps_so_far = chaos._counters.get("fleet.replica_step.0", 0)
+    chaos.crash_replica(0, steps_so_far + 2)
+    victims = [fleet.submit(prompt()) for _ in range(n_requests)]
+    clock.advance(1.0)
+    drain()
+    sampler.flush()
+    bundle_kinds = sorted(
+        os.path.basename(b).split("-", 2)[2] for b in recorder.bundles
+    )
+    drill_bundles = len(recorder.bundles)
+    rows = read_events_jsonl(events_path)
+    disk_traces = {r["trace_id"] for r in rows if r.get("trace_id")}
+    failed_tids = {r.trace_id for r in victims if r.status == "failed"}
+    crash_tids = set()
+    for b in recorder.bundles:
+        if b.endswith("replica_failure"):
+            with open(os.path.join(b, "manifest.json")) as fh:
+                crash_tids = set(_json.load(fh)["trigger"]["trace_ids"])
+    bad_traces = {
+        s.trace_id for s in tracer.finished
+        if s.status in TAIL_KEEP_STATUSES and s.trace_id
+    }
+    final = recorder.trigger("manual", "bench post-drill capture")
+    analysis = _json.loads(report_mod.run_incident(final, as_json=True))
+    decomp = analysis["decomposition"]
+    ttft_max = reg.snapshot()["histograms"]["serving_ttft_ms"]["max"]
+    counts = reg.counters()
+    return {
+        "requests": 2 * n_requests,
+        "sample_rate": sample_rate,
+        "triggers": int(counts.get("incident_triggers_total", 0)),
+        "bundles": drill_bundles,
+        "bundle_kinds": bundle_kinds,
+        "suppressed": int(counts.get("incident_suppressed_total", 0)),
+        "dump_errors": int(counts.get("incident_dump_errors_total", 0)),
+        "failed_requests": len(failed_tids),
+        "trace_join": bool(crash_tids) and crash_tids == failed_tids
+        and crash_tids <= disk_traces,
+        "nonok_traces_kept": bool(bad_traces) and bad_traces <= disk_traces,
+        "span_accounting_closed": (
+            counts.get("tracing_spans_kept_total", 0)
+            + counts.get("tracing_spans_sampled_out_total", 0)
+            == counts.get("tracing_spans_total", 0)
+        ),
+        "spans_sampled_out": int(
+            counts.get("tracing_spans_sampled_out_total", 0)
+        ),
+        "decomposition_exact": bool(decomp) and all(
+            r["unattributed_ms"] == 0.0
+            and round(sum(r["components"].values()), 3) == r["ttft_ms"]
+            for r in decomp
+        ) and decomp[0]["ttft_ms"] == round(float(ttft_max), 3),
+        "worst_request": decomp[0] if decomp else None,
+        "timeline_events": len(analysis["timeline"]),
+        "bundle_dir": recorder.dir,
     }
 
 

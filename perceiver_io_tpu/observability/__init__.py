@@ -46,6 +46,11 @@ from perceiver_io_tpu.observability.exporters import (
     snapshot_json,
     to_prometheus_text,
 )
+from perceiver_io_tpu.observability.flight_recorder import (
+    DisconnectWatch,
+    FlightRecorder,
+    IncidentArgs,
+)
 from perceiver_io_tpu.observability.ledger import (
     CompileLedger,
     LedgeredExecutor,
@@ -72,6 +77,7 @@ from perceiver_io_tpu.observability.slo import (
 )
 from perceiver_io_tpu.observability.tracing import (
     JsonlSpanSink,
+    SamplingSpanSink,
     Span,
     Tracer,
     read_events_jsonl,
@@ -101,20 +107,43 @@ class ObservabilityArgs:
     #: path (slot-engine ``serving_decode_step_ms`` / bucket-engine
     #: ``serving_device_execute_ms``) and captures the next dispatch
     profile_on_regress_factor: Optional[float] = None
+    #: head-sample the events.jsonl span stream: fraction of clean request
+    #: traces kept, in (0, 1] (docs/observability.md "Trace sampling").
+    #: Deterministic (counter-based, no RNG); traces ending in a non-ok
+    #: terminal status are ALWAYS kept, as are terminals slower than
+    #: ``trace_keep_slow_ms``. Requires ``events_path``. None = keep all.
+    trace_sample: Optional[float] = None
+    #: tail-keep latency threshold: a sampled-out trace whose terminal
+    #: span is at least this slow is retained anyway (None disables)
+    trace_keep_slow_ms: Optional[float] = None
+    #: on-disk bound for events.jsonl: past it the file rotates once to
+    #: ``events.jsonl.1`` (read back transparently); requires
+    #: ``events_path``. None = unbounded append (the historical behavior)
+    events_max_bytes: Optional[int] = None
     #: the ``--obs.slo.*`` sub-group: SLO targets (p95 TTFT / p95 ITL /
     #: error rate) plus burn-window knobs. Setting any target builds an
     #: :class:`SLOMonitor` for the serve run (docs/observability.md) —
     #: burn-rate gauges, breach counters/events, profiler-trigger arming,
     #: and (with ``--serve.replicas > 1``) tightened fleet admission.
     slo: SLOArgs = dataclasses.field(default_factory=SLOArgs)
+    #: the ``--obs.incident.*`` sub-group: the incident flight recorder
+    #: (docs/observability.md "Flight recorder & incident bundles").
+    #: Setting ``incident.dir`` arms triggered incident bundles at the
+    #: serving seams (SLO breach, replica failure, pool exhaustion,
+    #: autoscaler escalation, gateway mass-disconnect), each a bounded
+    #: atomic spans+state capture the ``obs incident`` analyzer reads.
+    incident: IncidentArgs = dataclasses.field(default_factory=IncidentArgs)
 
 
 __all__ = [
     "CompileLedger",
+    "DisconnectWatch",
+    "FlightRecorder",
     "GatewayHttpClient",
     "HELP_TEXT",
     "Histogram",
     "HttpStreamHandle",
+    "IncidentArgs",
     "JsonlSpanSink",
     "LedgeredExecutor",
     "LoadGenerator",
@@ -125,6 +154,7 @@ __all__ = [
     "SLOArgs",
     "SLOMonitor",
     "SLOPolicy",
+    "SamplingSpanSink",
     "SnapshotWriter",
     "Span",
     "Tracer",

@@ -140,6 +140,15 @@ HELP_TEXT = {
     "gateway_streams_rejected_total": "Generate submissions answered 400/503 (infeasible or shed) without becoming streams.",
     "gateway_bytes_sent_total": "Bytes written to gateway sockets (token events, terminals, error/metrics responses).",
     "gateway_socket_ttft_ms": "Socket-anchored time to first token: connection accept to the first token byte written.",
+    "tracing_spans_total": "Spans offered to the sampling span sink (in-scope and pass-through alike).",
+    "tracing_spans_kept_total": "Spans written through to the events sink (head-kept, tail-kept, or pass-through).",
+    "tracing_spans_sampled_out_total": "Spans dropped by trace sampling (kept + sampled_out == total).",
+    "tracing_traces_kept_total": "Request traces retained: head-sampled, non-ok terminal, or over the slow threshold.",
+    "tracing_traces_sampled_out_total": "Clean request traces dropped by head sampling (still in the in-memory ring).",
+    "incident_triggers_total": "Flight-recorder trigger firings from the wired seams (suppressed or not).",
+    "incident_bundles_total": "Incident bundles written to disk by the flight recorder.",
+    "incident_suppressed_total": "Triggers suppressed by per-kind cooldown or the max-bundles budget.",
+    "incident_dump_errors_total": "Incident bundle dumps that failed (capture must never compound the incident).",
 }
 
 #: prefix-matched fallbacks for generated families (per-reason counters,

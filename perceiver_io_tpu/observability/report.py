@@ -35,14 +35,33 @@ Entry points: ``<family CLI> obs report --events events.jsonl
 perceiver_io_tpu.observability.report events.jsonl --snapshot snap.json``
 (also behind ``make obs-report``). Stdlib-only: the analyzer must run
 where jax does not.
+
+**`obs incident`** (docs/observability.md "Flight recorder & incident
+bundles") is the second analyzer in this module: point it at one
+:class:`~perceiver_io_tpu.observability.FlightRecorder` bundle and it
+renders the trigger metadata, a causal timeline (breaches, replica
+failures, breaker transitions, scale events, cancellations, every non-ok
+terminal), the counter movement between the bundle's before/now registry
+snapshots, captured state (engine/fleet health, KV pool, autoscaler), and
+— the headline — a per-request **TTFT critical-path decomposition**
+straight from the span events the engines already emit: front-door wait
+(socket accept / fleet queue before the engine submit), engine queue
+wait, prefill (chunked admissions included), and the first decode step,
+telescoping EXACTLY to the request's recorded ``serving_ttft_ms`` — with
+the worst request pinned against the registry's nearest-rank percentiles
+like every other section.
 """
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+import os
+from typing import Dict, List, Optional, Tuple
 
 from perceiver_io_tpu.observability.registry import Histogram
-from perceiver_io_tpu.observability.tracing import read_events_jsonl
+from perceiver_io_tpu.observability.tracing import (
+    TAIL_KEEP_STATUSES,
+    read_events_jsonl,
+)
 
 
 def _percentiles(values: List[float]) -> dict:
@@ -912,6 +931,366 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
     return "\n".join(out)
 
 
+# -- `obs incident`: the flight-recorder bundle analyzer ---------------------
+
+#: events that BELONG on an incident's causal timeline regardless of
+#: status — the control-plane transitions around the trigger
+_CAUSAL_EVENTS = frozenset({
+    "slo.breach", "slo.recover",
+    "fleet.replica_failed", "fleet.breaker_open", "fleet.breaker_close",
+    "fleet.replica_restarted",
+    "autoscaler.scale_up", "autoscaler.scale_down",
+    "autoscaler.spawn_failed", "autoscaler.rung",
+    "serving.cancelled", "incident.dump",
+})
+
+#: terminal statuses that put a request span on the timeline — the same
+#: set the sampler tail-keeps, so every trace retained for being dirty
+#: also surfaces here
+_BAD_STATUSES = TAIL_KEEP_STATUSES
+
+
+def load_bundle(path: str) -> Tuple[dict, List[dict]]:
+    """Read one incident bundle — a directory holding ``manifest.json`` +
+    ``spans.jsonl`` (or a direct path to the manifest). Returns
+    ``(manifest, spans)``; raises ``ValueError`` on a schema the analyzer
+    does not understand."""
+    if os.path.isdir(path):
+        manifest_path = os.path.join(path, "manifest.json")
+    else:
+        manifest_path = path
+    with open(manifest_path) as fh:
+        manifest = json.load(fh)
+    if manifest.get("schema") != "incident-bundle-v1":
+        raise ValueError(
+            f"{manifest_path} is not an incident bundle "
+            f"(schema={manifest.get('schema')!r}; expected incident-bundle-v1)"
+        )
+    spans_path = os.path.join(os.path.dirname(manifest_path), "spans.jsonl")
+    spans = read_events_jsonl(spans_path) if os.path.exists(spans_path) else []
+    return manifest, spans
+
+
+def _by_trace(events: List[dict]) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    for row in events:
+        tid = row.get("trace_id")
+        if tid is not None:
+            out.setdefault(tid, []).append(row)
+    return out
+
+
+def ttft_decomposition(events: List[dict]) -> List[dict]:
+    """Per-request TTFT critical-path split from the span events the
+    engines already emit, worst first. Anchors are reconstructed from the
+    events themselves, so the components TELESCOPE: front_door + queue +
+    prefill + first_step == the request's recorded ``serving_ttft_ms``
+    exactly (``unattributed`` carries any rounding residue; 0.0 on a
+    FakeClock run — the acceptance pin).
+
+    Per trace: the terminal ``serving.request`` span's (backdated) start
+    is the ENGINE submit instant; ``serving.first_token``'s start is the
+    token instant and its ``ttft_ms`` attr reaches back to the TTFT
+    anchor (fleet front door / gateway socket accept), so the gap before
+    engine submit is the front-door share; ``serving.slot_assigned``
+    marks prefill completion (``prefill_ms`` device time, first
+    ``serving.prefill_chunk`` event marks the admission start when
+    chunked); what remains up to the token instant is the first decode
+    step. Bucket-engine traces (no slot events) fall back to a two-way
+    front-door / engine split (``batch_granular``)."""
+    rows: List[dict] = []
+    for trace_id, trace in sorted(_by_trace(events).items()):
+        first = next(
+            (r for r in trace if r.get("span") == "serving.first_token"), None
+        )
+        if first is None:
+            continue
+        attrs = first.get("attrs") or {}
+        ttft_ms = attrs.get("ttft_ms")
+        token_s = first.get("start_s")
+        if not isinstance(ttft_ms, (int, float)) or not isinstance(
+            token_s, (int, float)
+        ):
+            continue
+        anchor_s = token_s - ttft_ms / 1e3
+        terminal = next(
+            (r for r in trace if r.get("span") == "serving.request"), None
+        )
+        submit_s = terminal.get("start_s") if terminal else None
+        assigned = next(
+            (r for r in trace if r.get("span") == "serving.slot_assigned"),
+            None,
+        )
+        row = {
+            "trace_id": trace_id,
+            "ttft_ms": round(float(ttft_ms), 3),
+            "status": terminal.get("status") if terminal else None,
+            "prompt_len": (
+                (terminal.get("attrs") or {}).get("prompt_len")
+                if terminal else None
+            ),
+            "slot": attrs.get("slot"),
+        }
+        components: Dict[str, float] = {}
+        if assigned is not None and submit_s is not None:
+            a_attrs = assigned.get("attrs") or {}
+            prefill_end_s = assigned.get("start_s")
+            prefill_ms = float(a_attrs.get("prefill_ms") or 0.0)
+            chunks = sorted(
+                (r for r in trace if r.get("span") == "serving.prefill_chunk"),
+                key=lambda r: r.get("start_s") or 0.0,
+            )
+            if chunks:
+                c0 = chunks[0]
+                prefill_start_s = (
+                    float(c0.get("start_s") or prefill_end_s)
+                    - float((c0.get("attrs") or {}).get("ms") or 0.0) / 1e3
+                )
+            else:
+                prefill_start_s = prefill_end_s - prefill_ms / 1e3
+            components = {
+                "front_door_ms": (submit_s - anchor_s) * 1e3,
+                "queue_ms": (prefill_start_s - submit_s) * 1e3,
+                "prefill_ms": (prefill_end_s - prefill_start_s) * 1e3,
+                "first_step_ms": (token_s - prefill_end_s) * 1e3,
+            }
+            if a_attrs.get("chunks") is not None:
+                row["prefill_chunks"] = a_attrs["chunks"]
+        elif submit_s is not None:
+            # bucket engine: tokens materialize at batch completion — only
+            # the front-door / engine split is recoverable
+            components = {
+                "front_door_ms": (submit_s - anchor_s) * 1e3,
+                "engine_ms": (token_s - submit_s) * 1e3,
+            }
+            row["batch_granular"] = True
+        else:
+            components = {"engine_ms": float(ttft_ms)}
+        components = {k: round(v, 3) for k, v in components.items()}
+        row["components"] = components
+        row["unattributed_ms"] = round(
+            float(ttft_ms) - sum(components.values()), 3
+        )
+        rows.append(row)
+    rows.sort(key=lambda r: -r["ttft_ms"])
+    return rows
+
+
+def _counter_movement(manifest: dict) -> Optional[List[dict]]:
+    """Counters that MOVED between the bundle's last periodic snapshot and
+    the dump-time registry — the incident's disposition delta."""
+    metrics = manifest.get("metrics") or {}
+    before, now = metrics.get("before"), metrics.get("now")
+    if not before or not now:
+        return None
+    before_c = before.get("counters") or {}
+    out = []
+    for name, value in sorted((now.get("counters") or {}).items()):
+        delta = float(value) - float(before_c.get(name, 0.0))
+        if delta:
+            out.append({
+                "name": name, "before": float(before_c.get(name, 0.0)),
+                "now": float(value), "delta": round(delta, 6),
+            })
+    return out
+
+
+def analyze_incident(manifest: dict, spans: List[dict]) -> dict:
+    """Pure analysis over one loaded bundle; returns the JSON-able body
+    ``format_incident_report`` renders."""
+    trigger = dict(manifest.get("trigger") or {})
+    t0 = min(
+        (r["start_s"] for r in spans
+         if isinstance(r.get("start_s"), (int, float))),
+        default=float(trigger.get("at_s") or 0.0),
+    )
+    timeline = []
+    for r in sorted(spans, key=lambda r: r.get("start_s") or 0.0):
+        name = r.get("span", "?")
+        status = r.get("status")
+        if name not in _CAUSAL_EVENTS and status not in _BAD_STATUSES:
+            continue
+        attrs = r.get("attrs") or {}
+        timeline.append({
+            "offset_s": round(float(r.get("start_s") or t0) - t0, 6),
+            "event": name,
+            "status": status,
+            "trace_id": r.get("trace_id"),
+            "attrs": {
+                k: attrs[k] for k in
+                ("dimension", "burn_fast", "replica", "reason", "rung",
+                 "error", "in_flight", "stage", "cause", "trigger",
+                 "bundle", "replicas_after")
+                if k in attrs
+            },
+        })
+    now = (manifest.get("metrics") or {}).get("now") or {}
+    hists = now.get("histograms") or {}
+
+    def summ(name: str) -> Optional[dict]:
+        h = hists.get(name)
+        if h is None:
+            return None
+        return {
+            "count": h.get("count"), "p50_ms": h.get("p50"),
+            "p95_ms": h.get("p95"), "p99_ms": h.get("p99"),
+            "max_ms": h.get("max"),
+        }
+
+    decomposition = ttft_decomposition(spans)
+    replays = sum(
+        1 for r in spans
+        if r.get("span") == "fleet.dispatch"
+        and ((r.get("attrs") or {}).get("attempt") or 1) > 1
+    )
+    return {
+        "trigger": trigger,
+        "seq": manifest.get("seq"),
+        "spans": len(spans),
+        "trigger_offset_s": (
+            None if trigger.get("at_s") is None
+            else round(float(trigger["at_s"]) - t0, 6)
+        ),
+        "timeline": timeline,
+        "ttft": summ("serving_ttft_ms"),
+        "inter_token": summ("serving_inter_token_ms"),
+        "decomposition": decomposition,
+        "failover_replays": replays,
+        "counter_movement": _counter_movement(manifest),
+        "sources": manifest.get("sources") or {},
+    }
+
+
+def format_incident_report(analysis: dict, *, top: int = 8) -> str:
+    """Human-readable rendering of :func:`analyze_incident`'s output."""
+    out: List[str] = []
+    trig = analysis["trigger"]
+    out.append("== incident ==")
+    out.append(
+        f"trigger: {trig.get('kind')}  seq={analysis.get('seq')}  "
+        f"spans={analysis['spans']}"
+        + (
+            f"  at +{analysis['trigger_offset_s']:.3f} s"
+            if analysis.get("trigger_offset_s") is not None else ""
+        )
+    )
+    out.append(f"reason: {trig.get('reason')}")
+    if trig.get("trace_ids"):
+        out.append("trace ids: " + ", ".join(trig["trace_ids"]))
+
+    out.append("")
+    out.append("== causal timeline ==")
+    if analysis["timeline"]:
+        for row in analysis["timeline"]:
+            attrs = "".join(
+                f" {k}={v}" for k, v in (row["attrs"] or {}).items()
+            )
+            status = (
+                f" [{row['status']}]"
+                if row["status"] not in (None, "ok") else ""
+            )
+            trace = f"  ({row['trace_id']})" if row.get("trace_id") else ""
+            out.append(
+                f"  +{row['offset_s']:>10.3f} s  {row['event']:<26}"
+                f"{status}{attrs}{trace}"
+            )
+    else:
+        out.append("(no causal events in the span slice)")
+
+    out.append("")
+    out.append("== per-request ttft decomposition ==")
+    rows = analysis["decomposition"]
+    if rows:
+        keys = ("front_door_ms", "queue_ms", "prefill_ms", "first_step_ms",
+                "engine_ms")
+        out.append(
+            f"{'trace':<16}{'ttft_ms':>10}"
+            + "".join(f"{k[:-3]:>12}" for k in keys)
+            + f"{'unattrib':>10}  status"
+        )
+        for row in rows[:top]:
+            comp = row["components"]
+            out.append(
+                f"{str(row['trace_id']):<16}{_fmt(row['ttft_ms'])}"
+                + "".join(_fmt(comp.get(k), 12) for k in keys)
+                + f"{_fmt(row['unattributed_ms'])}  {row.get('status') or '-'}"
+            )
+        if len(rows) > top:
+            out.append(f"(+{len(rows) - top} more; --top to widen)")
+        if analysis.get("failover_replays"):
+            out.append(
+                f"failover replays in slice: {analysis['failover_replays']} "
+                "(re-dispatched requests replay from their prompts; the "
+                "replay wait lands in front_door)"
+            )
+    else:
+        out.append("(no serving.first_token events in the span slice)")
+
+    ttft = analysis.get("ttft")
+    if ttft:
+        out.append("")
+        out.append("== registry percentiles (nearest-rank, at dump) ==")
+        out.append(
+            f"{'metric':<14}{'count':>8}{'p50_ms':>10}{'p95_ms':>10}"
+            f"{'p99_ms':>10}{'max_ms':>10}"
+        )
+        for label, key in (("ttft", "ttft"), ("inter_token", "inter_token")):
+            row = analysis.get(key)
+            if row:
+                out.append(
+                    f"{label:<14}{_fmt(row['count'], 8)}{_fmt(row['p50_ms'])}"
+                    f"{_fmt(row['p95_ms'])}{_fmt(row['p99_ms'])}"
+                    f"{_fmt(row['max_ms'])}"
+                )
+        if rows and rows[0]["ttft_ms"] is not None and ttft.get("max_ms"):
+            out.append(
+                f"worst decomposed request = {rows[0]['ttft_ms']} ms "
+                f"(registry max {ttft['max_ms']} ms)"
+            )
+
+    movement = analysis.get("counter_movement")
+    if movement:
+        out.append("")
+        out.append("== counter movement (last snapshot -> dump) ==")
+        for row in movement:
+            out.append(
+                f"  {row['name']:<44} {row['before']:>10g} -> "
+                f"{row['now']:<10g} (+{row['delta']:g})"
+            )
+
+    sources = analysis.get("sources") or {}
+    if sources:
+        out.append("")
+        out.append("== captured state ==")
+        for name in sorted(sources):
+            state = sources[name]
+            if isinstance(state, dict):
+                # one line per source: the fields an operator reads first
+                keys = [
+                    k for k in (
+                        "ready", "replicas", "replicas_healthy", "draining",
+                        "queue_depth", "rung", "breached", "active_breaches",
+                        "in_use", "reserved", "blocks", "leaked",
+                        "frees_by_cause", "bundles", "error",
+                    ) if k in state
+                ]
+                summary = "  ".join(f"{k}={state[k]}" for k in keys)
+                out.append(f"  {name}: {summary or json.dumps(state)[:160]}")
+            else:
+                out.append(f"  {name}: {state}")
+    return "\n".join(out)
+
+
+def run_incident(bundle_path: str, *, top: int = 8,
+                 as_json: bool = False) -> str:
+    """Load one bundle, analyze, return the rendered incident report."""
+    manifest, spans = load_bundle(bundle_path)
+    analysis = analyze_incident(manifest, spans)
+    if as_json:
+        return json.dumps(analysis, indent=2, sort_keys=True)
+    return format_incident_report(analysis, top=top)
+
+
 def run(events_path: str, snapshot_path: Optional[str] = None, *,
         top: int = 20, as_json: bool = False) -> str:
     """Load artifacts, analyze, and return the rendered report (the string
@@ -932,25 +1311,42 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     parser = argparse.ArgumentParser(
         prog="perceiver_io_tpu.observability.report",
-        description="Offline obs report over events.jsonl (+ snapshot).",
+        description=(
+            "Offline obs report over events.jsonl (+ snapshot), or — with "
+            "--incident — over one flight-recorder bundle."
+        ),
     )
-    parser.add_argument("events", help="events.jsonl path (--obs.events_path)")
+    parser.add_argument("events", nargs="?", default=None,
+                        help="events.jsonl path (--obs.events_path)")
     parser.add_argument("--snapshot", default=None,
                         help="metrics snapshot JSON (--obs.snapshot_path)")
+    parser.add_argument("--incident", default=None,
+                        help="incident bundle directory (or its "
+                             "manifest.json) — renders the incident report "
+                             "instead of the events report")
     parser.add_argument("--top", type=int, default=20,
-                        help="rows shown in the compile table")
+                        help="rows shown in the compile table (report) / "
+                             "decomposition (incident)")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis JSON instead of text")
     args = parser.parse_args(argv)
     try:
-        print(run(args.events, args.snapshot, top=args.top, as_json=args.json))
-    except OSError as e:
-        raise SystemExit(f"obs report: {e}")
+        if args.incident is not None:
+            print(run_incident(args.incident, top=args.top, as_json=args.json))
+        elif args.events is None:
+            parser.error("an events.jsonl path (or --incident) is required")
+        else:
+            print(run(args.events, args.snapshot, top=args.top,
+                      as_json=args.json))
+    # JSONDecodeError IS a ValueError — it must be caught first or the
+    # generic clause swallows it without the file-name context
     except json.JSONDecodeError as e:
         raise SystemExit(
-            f"obs report: --snapshot is not valid JSON "
-            f"({args.snapshot}: {e})"
+            f"obs report: artifact is not valid JSON "
+            f"({args.incident or args.snapshot or args.events}: {e})"
         )
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"obs report: {e}")
     return 0
 
 

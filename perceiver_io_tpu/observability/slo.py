@@ -223,6 +223,7 @@ class SLOMonitor:
     def __init__(self, policy: SLOPolicy, *,
                  clock: Callable[[], float] = time.monotonic,
                  registry=None, tracer=None, profiler_trigger=None,
+                 flight_recorder=None,
                  fast_window_s: float = 60.0, slow_window_s: float = 600.0,
                  breach_burn_rate: float = 2.0, min_samples: int = 5):
         if fast_window_s <= 0 or slow_window_s <= 0:
@@ -240,6 +241,11 @@ class SLOMonitor:
         self.registry = registry
         self.tracer = tracer
         self.profiler_trigger = profiler_trigger
+        #: optional :class:`~perceiver_io_tpu.observability.FlightRecorder`
+        #: — a breach transition dumps an incident bundle (cooldown- and
+        #: budget-gated by the recorder), the same "a breach is the moment
+        #: a capture pays for itself" stance as the profiler-trigger arm
+        self.flight_recorder = flight_recorder
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
         self.breach_burn_rate = float(breach_burn_rate)
@@ -368,6 +374,15 @@ class SLOMonitor:
                     )
                 if self.profiler_trigger is not None:
                     self.profiler_trigger.arm()
+                if self.flight_recorder is not None:
+                    self.flight_recorder.trigger(
+                        "slo_breach",
+                        f"SLO {dim} breach: burn fast={fast:.2f} "
+                        f"slow={slow:.2f} (threshold "
+                        f"{self.breach_burn_rate})",
+                        dimension=dim, burn_fast=round(fast, 4),
+                        burn_slow=round(slow, 4),
+                    )
             elif (
                 self._breached[dim]
                 and fast < self.breach_burn_rate

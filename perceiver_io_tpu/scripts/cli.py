@@ -390,7 +390,8 @@ def _serve_prefix_cache(flag_value: str) -> str:
     return env_mode
 
 
-def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
+def _obs_kit(obs, root: str, *, is_main: bool = True,
+             passed: Optional[set] = None) -> Dict[str, Any]:
     """Materialize the ``--obs.*`` flag group (docs/observability.md) into
     registry / tracer / snapshot-writer / profiler-trigger objects. Every
     field defaults to off; the events sink, snapshot writer, and profiler
@@ -404,6 +405,7 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
         JsonlSpanSink,
         MetricsRegistry,
         ProfilerTrigger,
+        SamplingSpanSink,
         SnapshotWriter,
         Tracer,
     )
@@ -414,13 +416,46 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         return path
 
+    # inapplicable-flag convention: the sampling / rotation knobs shape the
+    # events.jsonl stream, so asking for them without a stream must not
+    # silently do nothing
+    if obs.events_path is None:
+        for flag, value in (
+            ("--obs.trace_sample", obs.trace_sample),
+            ("--obs.trace_keep_slow_ms", obs.trace_keep_slow_ms),
+            ("--obs.events_max_bytes", obs.events_max_bytes),
+        ):
+            if value is not None:
+                raise SystemExit(
+                    f"{flag} shapes the span stream; set --obs.events_path "
+                    "to enable it (docs/observability.md)"
+                )
+    if obs.trace_sample is not None and not 0.0 < obs.trace_sample <= 1.0:
+        raise SystemExit(
+            f"--obs.trace_sample must be in (0, 1], got {obs.trace_sample}"
+        )
+    if obs.trace_keep_slow_ms is not None and obs.trace_sample is None:
+        raise SystemExit(
+            "--obs.trace_keep_slow_ms is a trace-sampling tail-keep rule; "
+            "set --obs.trace_sample to enable sampling"
+        )
     registry = MetricsRegistry()
     sink = None
     tracer = None
     if obs.events_path is not None and is_main:
         import time
 
-        sink = JsonlSpanSink(_resolve(obs.events_path))
+        sink = JsonlSpanSink(
+            _resolve(obs.events_path), max_bytes=obs.events_max_bytes
+        )
+        if obs.trace_sample is not None:
+            # deterministic head sampling + tail-keep between tracer and
+            # disk (docs/observability.md "Trace sampling"); kit["sink"]
+            # is the OUTER sink so close() flushes undecided traces first
+            sink = SamplingSpanSink(
+                sink, rate=obs.trace_sample,
+                keep_slow_ms=obs.trace_keep_slow_ms, registry=registry,
+            )
         # per-run ID prefix: the sink appends, and a restarted process would
         # otherwise re-issue t000001... — colliding with the previous run's
         # spans in the same file and breaking the trace-ID join
@@ -463,6 +498,36 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
             slow_window_s=obs.slo.slow_window_s,
             breach_burn_rate=obs.slo.burn_rate,
         )
+    flight_recorder = None
+    if obs.incident.dir is not None:
+        if is_main:
+            from perceiver_io_tpu.observability import FlightRecorder
+
+            # the incident flight recorder (docs/observability.md "Flight
+            # recorder & incident bundles"): bundle dir resolved like the
+            # other --obs paths; the tracer is attached here when events
+            # are on and re-attached by run_serve (which always builds one)
+            incident_dir = obs.incident.dir
+            if not os.path.isabs(incident_dir):
+                incident_dir = os.path.join(root, incident_dir)
+            flight_recorder = FlightRecorder(
+                incident_dir,
+                tracer=tracer,
+                registry=registry,
+                cooldown_s=obs.incident.cooldown_s,
+                max_bundles=obs.incident.max_bundles,
+                keep_spans=obs.incident.keep_spans,
+            )
+    elif obs.incident != type(obs.incident)() or any(
+        k.startswith("obs.incident.") for k in (passed or ())
+    ):
+        # inapplicable-flag convention: tuning a recorder that was never
+        # enabled must not silently do nothing (`passed` catches a flag
+        # explicitly set to its default, which the dataclass compare misses)
+        raise SystemExit(
+            "--obs.incident.* tunes the incident flight recorder, which is "
+            "enabled by setting --obs.incident.dir (docs/observability.md)"
+        )
     trigger = None
     if obs.profile_on_regress_factor is not None and is_main:
         if jax.process_count() > 1:
@@ -481,6 +546,9 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
             )
     if slo_monitor is not None:
         slo_monitor.profiler_trigger = trigger
+        # an SLO breach dumps an incident bundle, same stance as arming
+        # the profiler trigger (docs/observability.md)
+        slo_monitor.flight_recorder = flight_recorder
     return {
         "registry": registry,
         "tracer": tracer,
@@ -488,6 +556,7 @@ def _obs_kit(obs, root: str, *, is_main: bool = True) -> Dict[str, Any]:
         "snapshot_writer": snapshot_writer,
         "trigger": trigger,
         "slo_monitor": slo_monitor,
+        "flight_recorder": flight_recorder,
     }
 
 
@@ -599,22 +668,48 @@ class CLI:
                 "(fit|validate|test|preproc|serve|obs)"
             )
         if subcommand == "obs":
-            # offline analyzer — no checkpoint, no datamodule, no jax work:
-            # `obs report` reads the artifacts a run left behind
+            # offline analyzers — no checkpoint, no datamodule, no jax work:
+            # `obs report` reads the artifacts a run left behind, `obs
+            # incident` reads one flight-recorder bundle
             # (docs/observability.md)
-            if len(argv) < 2 or argv[1] != "report":
+            if len(argv) < 2 or argv[1] not in ("report", "incident"):
                 raise SystemExit(
                     "usage: obs report --events <events.jsonl> "
-                    "[--snapshot <snapshot.json>] [--top N] [--json true]"
+                    "[--snapshot <snapshot.json>] [--top N] [--json true]\n"
+                    "       obs incident --bundle <incident dir> "
+                    "[--top N] [--json true]"
                 )
-            known = {"events": str, "snapshot": str, "top": int, "json": bool}
-            vals = _parse_dotted(argv[2:], known)
-            if "events" not in vals:
-                raise SystemExit("obs report requires --events <events.jsonl>")
             import json as _json
 
             from perceiver_io_tpu.observability import report as report_mod
 
+            if argv[1] == "incident":
+                known = {"bundle": str, "top": int, "json": bool}
+                vals = _parse_dotted(argv[2:], known)
+                if "bundle" not in vals:
+                    raise SystemExit(
+                        "obs incident requires --bundle <incident dir>"
+                    )
+                try:
+                    text = report_mod.run_incident(
+                        vals["bundle"], top=int(vals.get("top", 8)),
+                        as_json=bool(vals.get("json", False)),
+                    )
+                # JSONDecodeError IS a ValueError — catch it first, with
+                # the bundle path the generic message would drop
+                except _json.JSONDecodeError as e:
+                    raise SystemExit(
+                        f"obs incident: bundle manifest is not valid JSON "
+                        f"({vals.get('bundle')}: {e})"
+                    )
+                except (OSError, ValueError) as e:
+                    raise SystemExit(f"obs incident: {e}")
+                print(text)
+                return text
+            known = {"events": str, "snapshot": str, "top": int, "json": bool}
+            vals = _parse_dotted(argv[2:], known)
+            if "events" not in vals:
+                raise SystemExit("obs report requires --events <events.jsonl>")
             try:
                 text = report_mod.run(
                     vals["events"], vals.get("snapshot"),
@@ -737,7 +832,8 @@ class CLI:
 
         obs = build_dataclass(ObservabilityArgs, values, "obs")
         kit = _obs_kit(
-            obs, trainer_cfg.default_root_dir, is_main=jax.process_index() == 0
+            obs, trainer_cfg.default_root_dir,
+            is_main=jax.process_index() == 0, passed=set(values),
         )
         trainer = Trainer(
             trainer_cfg,
@@ -842,7 +938,7 @@ class CLI:
             raise SystemExit("serve requires --ckpt <save_pretrained dir>")
         args = build_dataclass(ServeArgs, values, "serve")
         obs = build_dataclass(ObservabilityArgs, values, "obs")
-        kit = _obs_kit(obs, os.getcwd())
+        kit = _obs_kit(obs, os.getcwd(), passed=set(values))
         # serve lines always carry a trace_id (the events.jsonl join key),
         # so the engine always gets a tracer — sink-less when --obs.events_path
         # is unset (spans stay in the bounded in-memory buffer).
@@ -851,6 +947,11 @@ class CLI:
             # slo.breach / slo.recover events land on the run's tracer
             # (into events.jsonl when configured — the obs-report timeline)
             kit["slo_monitor"].tracer = tracer
+        if kit["flight_recorder"] is not None:
+            # the recorder's span ring and its incident.dump events ride
+            # the run's one tracer (sink-less runs still bundle from the
+            # in-memory ring)
+            kit["flight_recorder"].tracer = tracer
         # the device-cost ledger's builds stream into events.jsonl as
         # `ledger.compile` events, so an offline `obs report` over the
         # events alone still carries the compile/memory table
@@ -988,15 +1089,21 @@ class CLI:
             )
             kv_mode = _serve_kv_layout(args.kv_layout)
             prefix_mode = _serve_prefix_cache(args.prefix_cache)
+            flight_recorder = kit["flight_recorder"]
             if args.engine == "slots":
                 def make_engine():
-                    return SlotServingEngine(
+                    eng = SlotServingEngine(
                         model, params, gen_cfg, table, slots=args.slots,
                         prefill_chunk=args.prefill_chunk,
                         kv_layout=kv_mode, kv_block_size=args.kv_block_size,
                         kv_blocks=args.kv_blocks, prefix_cache=prefix_mode,
                         **engine_kwargs
                     )
+                    # inside the factory, not after it: fleet replica
+                    # restarts / autoscaler spawns rebuild engines through
+                    # this factory and must keep the pool-exhaustion seam
+                    eng.flight_recorder = flight_recorder
+                    return eng
             else:
                 if args.prefill_chunk is not None:
                     raise SystemExit(
@@ -1024,9 +1131,11 @@ class CLI:
                     )
 
                 def make_engine():
-                    return ServingEngine(
+                    eng = ServingEngine(
                         model, params, gen_cfg, table, **engine_kwargs
                     )
+                    eng.flight_recorder = flight_recorder
+                    return eng
             if fleet_mode:
                 from perceiver_io_tpu.serving import FleetRouter
 
@@ -1046,6 +1155,9 @@ class CLI:
                     # sustained burn tightens max_pending/deadline shedding
                     slo_monitor=kit["slo_monitor"],
                     slo_shed_factor=obs.slo.shed_factor,
+                    # replica failures / breaker opens dump incident
+                    # bundles (docs/observability.md)
+                    flight_recorder=flight_recorder,
                 )
                 if autoscale.max is not None:
                     from perceiver_io_tpu.serving import FleetAutoscaler
@@ -1076,6 +1188,27 @@ class CLI:
                     kit["slo_monitor"].watch_counters(
                         kit["registry"].counters, prefix="serving"
                     )
+            if flight_recorder is not None:
+                # dump-time state sources (docs/observability.md): health
+                # (the fleet's embeds replica_detail), SLO burn state,
+                # autoscaler ladder state, and the paged pool(s)
+                flight_recorder.add_source("health", engine.health)
+                if kit["slo_monitor"] is not None:
+                    flight_recorder.add_source("slo", kit["slo_monitor"].stats)
+                autoscaler = getattr(engine, "autoscaler", None)
+                if autoscaler is not None:
+                    flight_recorder.add_source("autoscaler", autoscaler.stats)
+                if fleet_mode:
+                    def _fleet_pools():
+                        return {
+                            str(r.replica_id): r.engine._pool.stats()
+                            for r in engine.replicas
+                            if getattr(r.engine, "_pool", None) is not None
+                        }
+
+                    flight_recorder.add_source("kv_pool", _fleet_pools)
+                elif getattr(engine, "_pool", None) is not None:
+                    flight_recorder.add_source("kv_pool", engine._pool.stats)
             if args.warmup:
                 t0 = time.monotonic()
                 compiles = engine.warmup()
@@ -1147,6 +1280,7 @@ class CLI:
             tracer=engine.tracer if hasattr(engine, "tracer") else None,
             slo_monitor=kit["slo_monitor"],
             snapshot_writer=kit["snapshot_writer"],
+            flight_recorder=kit["flight_recorder"],
             max_streams=args.http.max_streams,
         )
         gateway.run_in_thread()
@@ -1185,6 +1319,8 @@ class CLI:
             stats["process_metrics"] = default_registry().snapshot()
             if kit["slo_monitor"] is not None and "slo" not in stats:
                 stats["slo"] = kit["slo_monitor"].stats()
+            if kit["flight_recorder"] is not None:
+                stats["incident"] = kit["flight_recorder"].stats()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return []
 
@@ -1228,6 +1364,8 @@ class CLI:
                 )
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write()
+            if kit["flight_recorder"] is not None:
+                kit["flight_recorder"].maybe_record()
         # CLI-driven drain (not the blocking engine.drain()): the snapshot
         # cadence must keep firing while the queue — the bulk of the run's
         # wall time — generates, or a mid-run poller sees stale telemetry.
@@ -1247,6 +1385,10 @@ class CLI:
                 slo_monitor.poll()
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write()
+            if kit["flight_recorder"] is not None:
+                # the incident ring's periodic "before" evidence rides the
+                # same opportunistic cadence as the snapshot writer
+                kit["flight_recorder"].maybe_record()
         if slo_monitor is not None:
             # unconditional final poll: the fleet router polls at the START
             # of each step, so the last step's dispositions would otherwise
@@ -1292,6 +1434,9 @@ class CLI:
                 # runs attach it here so serve_stats always carries the
                 # burn/breach summary when SLO targets were set
                 stats["slo"] = kit["slo_monitor"].stats()
+            if kit["flight_recorder"] is not None:
+                # the run's one durable record names every bundle written
+                stats["incident"] = kit["flight_recorder"].stats()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return results
 
@@ -1321,7 +1466,14 @@ class CLI:
               "(docs/serving.md)")
         print("observability: --obs.events_path=<events.jsonl> --obs.snapshot_every_s "
               "--obs.snapshot_path --obs.profile_on_regress_factor "
-              "(fit and serve; docs/observability.md)")
+              "--obs.trace_sample=<0..1> --obs.trace_keep_slow_ms "
+              "--obs.events_max_bytes (fit and serve; docs/observability.md)")
+        print("incident flight recorder: --obs.incident.dir=<dir> "
+              "--obs.incident.cooldown_s --obs.incident.max_bundles "
+              "--obs.incident.keep_spans — triggered bounded bundles at the "
+              "serving seams (SLO breach, replica failure, pool exhaustion, "
+              "autoscaler escalation, gateway mass-disconnect); analyze with "
+              "obs incident --bundle=<dir>")
         print("slo (serve): --obs.slo.ttft_p95_ms --obs.slo.inter_token_p95_ms "
               "--obs.slo.error_rate --obs.slo.fast_window_s --obs.slo.slow_window_s "
               "--obs.slo.burn_rate --obs.slo.shed_factor — burn-rate monitor, "

@@ -122,7 +122,7 @@ class FleetAutoscaler:
                  queue_high: float = 1.0, queue_low: float = 0.25,
                  scale_up_slots: Optional[int] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 registry=None, tracer=None):
+                 registry=None, tracer=None, flight_recorder=None):
         if min_replicas < 1:
             raise ValueError(f"min_replicas must be >= 1, got {min_replicas}")
         if max_replicas < min_replicas:
@@ -155,6 +155,15 @@ class FleetAutoscaler:
         self._clock = clock if clock is not None else fleet._clock
         self.registry = registry if registry is not None else fleet.registry
         self.tracer = tracer if tracer is not None else fleet.tracer
+        #: optional incident
+        #: :class:`~perceiver_io_tpu.observability.FlightRecorder` — a
+        #: ladder walk UP to scale_up/shed or a spawn failure dumps a
+        #: bundle (docs/observability.md "Flight recorder & incident
+        #: bundles"); defaults to the fleet's own when it has one
+        self.flight_recorder = (
+            flight_recorder if flight_recorder is not None
+            else getattr(fleet, "flight_recorder", None)
+        )
         self.registry.declare_counters(*AUTOSCALER_COUNTERS)
         self.rung = "steady"
         self._breach_streak = 0
@@ -266,6 +275,16 @@ class FleetAutoscaler:
             self.spawn_failures += 1
             self._last_up_at = now
             self._breach_streak = 0
+            if self.flight_recorder is not None:
+                # the fleet needed capacity and could not get it — the
+                # bundle preserves what the control loop saw at that moment
+                self.flight_recorder.trigger(
+                    "spawn_failed",
+                    f"replica spawn failed while scaling up ({reason}; "
+                    f"queue depth {depth}, capacity {capacity})",
+                    reason=reason, queue_depth=depth, capacity=capacity,
+                    replicas=before,
+                )
             return "spawn_failed"
         if self.scale_up_slots is not None:
             resize = getattr(replica.engine, "resize_slots", None)
@@ -349,6 +368,21 @@ class FleetAutoscaler:
                 self.tracer.event(
                     "autoscaler.rung", rung=rung, previous=self.rung,
                     index=LADDER.index(rung),
+                )
+            if (
+                self.flight_recorder is not None
+                and rung in ("scale_up", "shed")
+                and LADDER.index(rung) > LADDER.index(self.rung)
+            ):
+                # the ladder walked UP past admission tightening: capacity
+                # is being added (or is exhausted) — incident-worthy; the
+                # recorder's per-kind cooldown keeps a long incident to
+                # one bundle
+                self.flight_recorder.trigger(
+                    "autoscaler_escalation",
+                    f"degradation ladder escalated {self.rung} -> {rung}",
+                    rung=rung, previous=self.rung,
+                    replicas=len(self.fleet.replicas),
                 )
             self.rung = rung
 

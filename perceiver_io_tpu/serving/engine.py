@@ -293,6 +293,13 @@ class ServingEngine:
         #: :class:`~perceiver_io_tpu.observability.slo.SLOMonitor`'s
         #: ``sink`` plugs in the same way.
         self.latency_sink: Optional[Callable[[str, float], None]] = None
+        #: optional incident
+        #: :class:`~perceiver_io_tpu.observability.FlightRecorder` — the
+        #: slot engine fires its ``pool_exhausted`` seam when an admission
+        #: stalls on KV pool blocks (docs/observability.md "Flight
+        #: recorder & incident bundles"); None skips the seam, the same
+        #: contract as ``tracer``/``chaos``
+        self.flight_recorder = None
 
     def _observe_token_latency(self, name: str, value_ms: float) -> None:
         """One TTFT / inter-token observation: engine registry first (the

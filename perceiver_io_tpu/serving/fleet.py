@@ -387,7 +387,8 @@ class FleetRouter:
                  redispatch_policy: Optional[RetryPolicy] = None,
                  redispatch_seed: int = 0,
                  slo_monitor=None,
-                 slo_shed_factor: float = 0.5):
+                 slo_shed_factor: float = 0.5,
+                 flight_recorder=None):
         factories = list(engine_factories)
         if not factories:
             raise ValueError("a fleet needs at least one engine factory")
@@ -413,6 +414,13 @@ class FleetRouter:
             )
         self.slo_monitor = slo_monitor
         self.slo_shed_factor = float(slo_shed_factor)
+        #: optional incident
+        #: :class:`~perceiver_io_tpu.observability.FlightRecorder`
+        #: (docs/observability.md "Flight recorder & incident bundles"):
+        #: a replica failure or a breaker opening dumps a bounded bundle
+        #: with the victims' trace ids attached — the moments the span
+        #: firehose alone cannot reconstruct after sampling
+        self.flight_recorder = flight_recorder
         self._rng = random.Random(redispatch_seed)
         self._breaker_threshold = int(breaker_threshold)
         self._breaker_cooldown_s = float(breaker_cooldown_s)
@@ -776,8 +784,27 @@ class FleetRouter:
                     "fleet.breaker_open", replica=replica.replica_id,
                     consecutive_failures=replica.breaker.consecutive_failures,
                 )
+            if self.flight_recorder is not None:
+                self.flight_recorder.trigger(
+                    "breaker_open",
+                    f"replica {replica.replica_id} breaker opened after "
+                    f"{replica.breaker.consecutive_failures} consecutive "
+                    "failures",
+                    trace_ids=self._inflight_trace_ids(replica),
+                    replica=replica.replica_id,
+                )
         self._update_gauges()
         return opened
+
+    def _inflight_trace_ids(self, replica: Replica) -> List[str]:
+        """Trace ids of the fleet requests whose live dispatch sits on
+        ``replica`` — the join evidence an incident bundle carries."""
+        return [
+            self._dispatched[fid].trace_id
+            for fid in replica.handles
+            if fid in self._dispatched
+            and self._dispatched[fid].trace_id is not None
+        ]
 
     def _requeue(self, req: FleetRequest, error: str, *,
                  avoid_replica_id: Optional[int] = None,
@@ -1010,6 +1037,16 @@ class FleetRouter:
         """Replica-level step failure: charge the breaker, fail over (or
         fail) its in-flight requests, rebuild a crashed replica. Returns
         terminal dispositions caused here."""
+        if self.flight_recorder is not None:
+            # capture BEFORE the failover sweep mutates the dispatch maps:
+            # the bundle's trace ids name the victims as they were
+            self.flight_recorder.trigger(
+                "replica_failure",
+                f"replica {replica.replica_id} {reason}: {error}",
+                trace_ids=self._inflight_trace_ids(replica),
+                replica=replica.replica_id, failure_reason=reason,
+                in_flight=len(replica.handles),
+            )
         self._charge_breaker(replica)
         if self.tracer is not None:
             self.tracer.event(

@@ -165,8 +165,11 @@ class StreamingGateway:
                  registry=None, tracer=None, chaos=None,
                  clock: Callable[[], float] = time.monotonic,
                  slo_monitor=None, snapshot_writer=None,
+                 flight_recorder=None,
                  max_streams: Optional[int] = None,
-                 idle_sleep_s: float = 0.002):
+                 idle_sleep_s: float = 0.002,
+                 mass_disconnect_threshold: int = 3,
+                 mass_disconnect_window_s: float = 5.0):
         if stream not in STREAM_MODES:
             raise ValueError(
                 f"stream must be one of {STREAM_MODES}, got {stream!r}"
@@ -185,6 +188,19 @@ class StreamingGateway:
         self._clock = clock
         self.slo_monitor = slo_monitor
         self.snapshot_writer = snapshot_writer
+        #: optional incident
+        #: :class:`~perceiver_io_tpu.observability.FlightRecorder` —
+        #: ``mass_disconnect_threshold`` client disconnects inside
+        #: ``mass_disconnect_window_s`` fire its ``mass_disconnect`` seam
+        #: (docs/observability.md "Flight recorder & incident bundles"):
+        #: one abandoned stream is churn, a burst is an incident
+        self.flight_recorder = flight_recorder
+        from perceiver_io_tpu.observability.flight_recorder import DisconnectWatch
+
+        self._disconnect_watch = DisconnectWatch(
+            threshold=mass_disconnect_threshold,
+            window_s=mass_disconnect_window_s, clock=clock,
+        )
         self.max_streams = max_streams
         self.idle_sleep_s = float(idle_sleep_s)
         # the fleet router polls its own monitor per step(); polling it
@@ -322,6 +338,10 @@ class StreamingGateway:
                 self.slo_monitor.poll()
             if self.snapshot_writer is not None:
                 self.snapshot_writer.maybe_write()
+            if self.flight_recorder is not None:
+                # the flight recorder's periodic "before" evidence rides
+                # the same per-pass cadence hook as the snapshot writer
+                self.flight_recorder.maybe_record()
             self._flush_terminal()
             # yield so handlers drain their queues between steps; nap when
             # idle instead of hot-spinning the loop
@@ -547,6 +567,23 @@ class StreamingGateway:
         stream.counted = True
         if cancelled:
             self.registry.inc("gateway_streams_cancelled_total")
+            if (
+                self.flight_recorder is not None
+                and self._disconnect_watch.note()
+            ):
+                self.flight_recorder.trigger(
+                    "mass_disconnect",
+                    f"{self._disconnect_watch.threshold} client disconnects "
+                    f"within {self._disconnect_watch.window_s}s "
+                    f"(stream {stream.stream_id} last)",
+                    trace_ids=(
+                        [stream.handle.trace_id]
+                        if stream.handle.trace_id else []
+                    ),
+                    stream_id=stream.stream_id,
+                    threshold=self._disconnect_watch.threshold,
+                    window_s=self._disconnect_watch.window_s,
+                )
         else:
             self.registry.inc("gateway_streams_completed_total")
 

@@ -1917,6 +1917,26 @@ class SlotServingEngine(ServingEngine):
                     if self._kv_waiting_id != head.request_id:
                         self._kv_waiting_id = head.request_id
                         self.registry.inc("kv_pool_admit_waits_total")
+                        if self.flight_recorder is not None:
+                            # pool exhaustion is incident-worthy exactly
+                            # once per waiting request (the counter's own
+                            # once-per-wait discipline), and the recorder's
+                            # cooldown bounds a thrashing pool further
+                            pool = self._pool.stats()
+                            self.flight_recorder.trigger(
+                                "pool_exhausted",
+                                f"admission stalled: request "
+                                f"{head.request_id} needs {int(need)} pool "
+                                f"blocks, {pool['blocks'] - pool['reserved']}"
+                                f" of {pool['blocks']} unreserved",
+                                trace_ids=(
+                                    [head.trace_id] if head.trace_id else []
+                                ),
+                                request_id=head.request_id,
+                                blocks_needed=int(need),
+                                blocks=pool["blocks"],
+                                blocks_reserved=pool["reserved"],
+                            )
                     break
                 # eviction may have shrunk the plan and flipped the head
                 # onto the (busy) chunk lane — re-check before admitting

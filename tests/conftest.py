@@ -86,6 +86,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "flight_recorder: incident flight-recorder test (deterministic "
+        "trace sampling with tail-keep, triggered incident bundles, "
+        "per-request TTFT decomposition; observability/flight_recorder.py, "
+        "observability/tracing.py, observability/report.py; "
+        "docs/observability.md); CPU-fast, runs in the tier-1 suite with a "
+        "tight per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "gateway: HTTP/SSE streaming-gateway test (per-token streaming over "
         "real sockets, client-disconnect cancellation, socket-anchored TTFT; "
         "serving/gateway.py, docs/serving.md); CPU-fast, runs in the tier-1 "

@@ -1,0 +1,612 @@
+"""Incident flight-recorder suite (docs/observability.md "Trace
+sampling" / "Flight recorder & incident bundles" / "obs incident").
+
+Three connected layers, all deterministic under FakeClock:
+
+- **trace sampling** — `SamplingSpanSink` head-samples the per-request
+  span firehose (counter-based, no RNG) with tail-keep for every non-ok
+  terminal and for slow terminals; kept + sampled_out == total closes the
+  accounting, and sampled-out traces still land in the tracer's
+  in-memory ring.
+- **flight recorder** — bounded atomic incident bundles at the wired
+  seams, one per trigger kind inside the cooldown, capped by the
+  lifetime budget; `trigger()` never raises.
+- **`obs incident`** — the analyzer over a bundle: causal timeline plus
+  a per-request TTFT decomposition whose components telescope EXACTLY to
+  the recorded `serving_ttft_ms` (the acceptance pin).
+
+The load-bearing drill (`test_incident_chaos_drill_end_to_end`): a
+replica crash mid-decode during an SLO breach produces exactly one
+bundle per trigger kind, the bundles' trace ids join events.jsonl, and
+10% sampling still keeps 100% of non-ok terminal traces.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.inference.generate import GenerationConfig, SamplingConfig
+from perceiver_io_tpu.models.text.clm import (
+    CausalLanguageModel,
+    CausalLanguageModelConfig,
+)
+from perceiver_io_tpu.observability import (
+    DisconnectWatch,
+    FlightRecorder,
+    JsonlSpanSink,
+    MetricsRegistry,
+    SamplingSpanSink,
+    SLOMonitor,
+    SLOPolicy,
+    Tracer,
+    read_events_jsonl,
+)
+from perceiver_io_tpu.observability import report as report_mod
+from perceiver_io_tpu.observability.exporters import HELP_TEXT, help_text
+from perceiver_io_tpu.observability.tracing import TAIL_KEEP_STATUSES
+from perceiver_io_tpu.reliability import ChaosRegistry, FakeClock, RetryPolicy
+from perceiver_io_tpu.serving import BucketTable, FleetRouter, SlotServingEngine
+
+pytestmark = [pytest.mark.flight_recorder, pytest.mark.timeout(300)]
+
+KEY = jax.random.PRNGKey(0)
+
+# Deliberately NOT a shape other test modules use: executor cache keys
+# include the module fingerprint, and an identically-configured model in
+# another file would pre-populate the cache this file relies on warming.
+TINY = dict(
+    vocab_size=89, max_seq_len=32, max_latents=8, num_channels=16,
+    num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = CausalLanguageModelConfig(**TINY)
+    model = CausalLanguageModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 8)["params"]
+    return model, params
+
+
+def _gcfg(max_new=4, num_latents=2):
+    return GenerationConfig(
+        max_new_tokens=max_new, num_latents=num_latents,
+        sampling=SamplingConfig(temperature=0.0),
+    )
+
+
+def _row(span, trace_id, *, status="ok", start_s=0.0, duration_ms=1.0,
+         **attrs):
+    return {
+        "span": span, "trace_id": trace_id, "span_id": f"s-{trace_id}-{span}",
+        "parent_id": None, "start_s": start_s, "duration_ms": duration_ms,
+        "status": status, "attrs": attrs,
+    }
+
+
+def _request_trace(i, *, status="ok", terminal_ms=10.0):
+    tid = f"t{i:06d}"
+    return [
+        _row("serving.first_token", tid, ttft_ms=5.0),
+        _row("serving.request", tid, status=status, duration_ms=terminal_ms),
+    ]
+
+
+# -- trace sampling ---------------------------------------------------------
+def test_sampling_sink_head_and_tail_keep_accounting():
+    """Deterministic head sampling at 10%: every 10th clean trace streams
+    through, every non-ok terminal trace is kept regardless, and the
+    span counters reconcile kept + sampled_out == total."""
+    reg = MetricsRegistry()
+    out = []
+    sink = SamplingSpanSink(out.append, rate=0.1, registry=reg)
+    assert sink.stride == 10
+    statuses = {}
+    for i in range(30):
+        # every 7th request ends dirty — deliberately off-phase with the
+        # 1-in-10 head stride so tail-keep is doing real work
+        status = "timed_out" if i % 7 == 3 else "ok"
+        statuses[f"t{i:06d}"] = status
+        for row in _request_trace(i, status=status):
+            sink(row)
+    kept_traces = {r["trace_id"] for r in out}
+    # head-kept: trace seq 0, 10, 20; tail-kept: every non-ok terminal
+    assert {f"t{i:06d}" for i in (0, 10, 20)} <= kept_traces
+    bad = {t for t, s in statuses.items() if s != "ok"}
+    assert bad <= kept_traces  # 100% of non-ok traces retained
+    assert kept_traces == {f"t{i:06d}" for i in (0, 10, 20)} | bad
+    # a kept trace keeps ALL its spans (buffered head spans replay)
+    for tid in kept_traces:
+        assert [r["span"] for r in out if r["trace_id"] == tid] == [
+            "serving.first_token", "serving.request"
+        ]
+    c = reg.counters()
+    assert c["tracing_spans_total"] == 60
+    assert (
+        c["tracing_spans_kept_total"] + c["tracing_spans_sampled_out_total"]
+        == c["tracing_spans_total"]
+    )
+    assert c["tracing_spans_kept_total"] == 2 * len(kept_traces)
+    assert c["tracing_traces_kept_total"] == len(kept_traces)
+    assert c["tracing_traces_sampled_out_total"] == 30 - len(kept_traces)
+    # TAIL_KEEP_STATUSES covers every non-ok disposition the engines emit
+    assert TAIL_KEEP_STATUSES == {
+        "shed", "timed_out", "failed", "rejected", "cancelled", "error"
+    }
+
+
+def test_sampling_sink_tail_keeps_slow_traces():
+    """keep_slow_ms: a clean trace whose terminal span is at/over the
+    threshold is retained even when head sampling would drop it."""
+    out = []
+    sink = SamplingSpanSink(out.append, rate=0.01, keep_slow_ms=100.0)
+    for i in range(5):
+        ms = 250.0 if i == 3 else 10.0
+        for row in _request_trace(i, terminal_ms=ms):
+            sink(row)
+    kept = {r["trace_id"] for r in out}
+    assert kept == {"t000000", "t000003"}  # head-kept seq 0 + the slow one
+
+
+def test_sampling_sink_passes_operational_spans_through():
+    """Only the per-request firehose is sampled: ledger/slo/autoscaler/
+    incident spans and traceless rows always write through, counted as
+    kept so the accounting still closes."""
+    reg = MetricsRegistry()
+    out = []
+    sink = SamplingSpanSink(out.append, rate=0.001, registry=reg)
+    sink(_row("ledger.compile", "t900001"))
+    sink(_row("slo.breach", "t900002", dimension="ttft"))
+    sink(_row("incident.dump", "t900003"))
+    sink({"span": "trainer.step", "trace_id": None, "status": "ok"})
+    assert len(out) == 4
+    c = reg.counters()
+    assert c["tracing_spans_kept_total"] == c["tracing_spans_total"] == 4
+
+
+def test_sampling_sink_flush_keeps_interrupted_traces(tmp_path):
+    """close() flushes undecided (terminal-less) traces to disk — an
+    interrupted request is exactly what a post-mortem wants — then closes
+    the wrapped JSONL sink."""
+    path = str(tmp_path / "events.jsonl")
+    sink = SamplingSpanSink(JsonlSpanSink(path), rate=0.5)
+    sink(_row("serving.first_token", "t000000", ttft_ms=1.0))  # head-kept
+    sink(_row("serving.first_token", "t000001", ttft_ms=2.0))  # undecided
+    sink.close()
+    rows = read_events_jsonl(path)
+    assert {r["trace_id"] for r in rows} == {"t000000", "t000001"}
+    assert sink.stats()["pending_traces"] == 0
+
+
+def test_sampling_sink_validation():
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="rate"):
+            SamplingSpanSink(lambda r: None, rate=bad)
+    with pytest.raises(ValueError, match="max_pending"):
+        SamplingSpanSink(lambda r: None, rate=0.5, max_pending=0)
+
+
+def test_sampling_sink_pending_bound_force_drops_oldest():
+    """A trace whose terminal never arrives cannot grow the buffer
+    forever: overflow force-drops the oldest undecided trace, counted."""
+    reg = MetricsRegistry()
+    out = []
+    sink = SamplingSpanSink(out.append, rate=0.01, registry=reg,
+                            max_pending=4)
+    for i in range(12):  # no terminals: all buffer (seq 0 head-kept)
+        sink(_row("serving.first_token", f"t{i:06d}"))
+    assert sink.stats()["pending_traces"] <= 4
+    c = reg.counters()
+    # overflow victims were decided (dropped); at most max_pending spans
+    # remain genuinely undecided until flush
+    assert c["tracing_spans_sampled_out_total"] == 12 - 1 - 4
+    sink.flush()  # decides the survivors -> the accounting closes
+    c = reg.counters()
+    assert (
+        c["tracing_spans_kept_total"] + c["tracing_spans_sampled_out_total"]
+        == c["tracing_spans_total"] == 12
+    )
+
+
+# -- JsonlSpanSink hardening (satellites) -----------------------------------
+def test_jsonl_sink_numpy_attr_does_not_kill_the_run(tmp_path):
+    """Regression: a span attr json cannot natively encode (numpy scalar,
+    arbitrary object) must not raise through the telemetry path — numpy
+    scalars stay numeric via .item(), exotica degrade to str."""
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSpanSink(path)
+    clock = FakeClock()
+    tracer = Tracer(clock=clock, sink=sink)
+    tracer.event("serving.first_token", trace_id="t1",
+                 ttft_ms=np.float32(12.5), slot=np.int64(3))
+
+    class Exotic:
+        def __repr__(self):
+            return "Exotic()"
+
+    tracer.event("serving.request", trace_id="t1", payload=Exotic())
+    sink.close()
+    assert sink.write_errors == 0
+    rows = read_events_jsonl(path)
+    assert rows[0]["attrs"]["ttft_ms"] == 12.5  # numeric, not a string
+    assert rows[0]["attrs"]["slot"] == 3
+    assert rows[1]["attrs"]["payload"] == "Exotic()"
+
+
+def test_jsonl_sink_rotation_bounds_disk(tmp_path):
+    """max_bytes: the live file rotates once to .1 when an append would
+    cross the bound; read_events_jsonl reads the pair in write order and
+    still skips torn trailing lines."""
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSpanSink(path, max_bytes=2048)
+    n = 64
+    for i in range(n):
+        sink({"span": "serving.request", "trace_id": f"t{i:06d}",
+              "status": "ok", "pad": "x" * 64})
+    sink.close()
+    assert sink.rotations >= 1
+    assert os.path.getsize(path) <= 2048
+    assert os.path.getsize(path + ".1") <= 2048
+    rows = read_events_jsonl(path)
+    # single-file rotation: the pair holds a contiguous SUFFIX of the
+    # stream, in write order, ending at the last row written
+    ids = [r["trace_id"] for r in rows]
+    assert ids == [f"t{i:06d}" for i in range(n - len(ids), n)]
+    assert len(ids) >= 2048 // 128  # at least one full file's worth
+    # torn trailing line in the live file: skipped, rotated rows intact
+    with open(path, "a") as fh:
+        fh.write('{"span": "serving.requ')
+    assert [r["trace_id"] for r in read_events_jsonl(path)] == ids
+    with pytest.raises(ValueError, match="max_bytes"):
+        JsonlSpanSink(str(tmp_path / "e2.jsonl"), max_bytes=0)
+
+
+# -- flight recorder --------------------------------------------------------
+def test_disconnect_watch_window():
+    clock = FakeClock()
+    watch = DisconnectWatch(threshold=3, window_s=5.0, clock=clock)
+    assert not watch.note()
+    clock.advance(6.0)  # the first disconnect ages out of the window
+    assert not watch.note()
+    clock.advance(1.0)
+    assert not watch.note()
+    clock.advance(1.0)
+    assert watch.note()  # 3 inside 5s -> fires and resets
+    assert not watch.note()  # reset: the burst was consumed
+    with pytest.raises(ValueError):
+        DisconnectWatch(threshold=0)
+    with pytest.raises(ValueError):
+        DisconnectWatch(window_s=0.0)
+
+
+def test_flight_recorder_cooldown_budget_and_atomic_bundles(tmp_path):
+    """The trigger discipline: one bundle per kind inside the cooldown,
+    a lifetime max-bundles budget, suppressions counted, bundles atomic
+    (no .tmp residue), manifest carrying trigger metadata + before/now
+    snapshots + dump-time sources (a raising source contributes its
+    error string instead of aborting the bundle)."""
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    tracer = Tracer(clock=clock)
+    rec = FlightRecorder(
+        str(tmp_path / "incidents"), tracer=tracer, registry=reg,
+        clock=clock, cooldown_s=60.0, max_bundles=3, keep_spans=4,
+        snapshot_every_s=5.0,
+    )
+    rec.add_source("health", lambda: {"ready": True, "queue_depth": 2})
+
+    def broken():
+        raise RuntimeError("probe died")
+
+    rec.add_source("kv_pool", broken)
+    for i in range(6):
+        tracer.event("serving.request", trace_id=f"t{i:06d}",
+                     status="ok" if i else "timed_out")
+        clock.advance(0.01)
+    rec.maybe_record(force=True)
+    clock.advance(1.0)
+    first = rec.trigger("slo_breach", "ttft burning", trace_ids=["t000001"],
+                        dimension="ttft")
+    assert first is not None and os.path.isdir(first)
+    # same kind inside the cooldown: suppressed; another kind: fine
+    assert rec.trigger("slo_breach", "still burning") is None
+    second = rec.trigger("replica_failure", "replica 1 crash", replica=1)
+    assert second is not None
+    clock.advance(61.0)  # cooldown expires -> same kind fires again
+    third = rec.trigger("slo_breach", "burning again")
+    assert third is not None
+    # lifetime budget exhausted: everything suppresses from here
+    assert rec.trigger("manual", "over budget") is None
+    c = reg.counters()
+    assert c["incident_triggers_total"] == 5
+    assert c["incident_bundles_total"] == 3
+    assert c["incident_suppressed_total"] == 2
+    assert c["incident_dump_errors_total"] == 0
+    assert not [d for d in os.listdir(rec.dir) if d.startswith(".")]
+    with open(os.path.join(first, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["schema"] == "incident-bundle-v1"
+    assert manifest["trigger"]["kind"] == "slo_breach"
+    assert manifest["trigger"]["trace_ids"] == ["t000001"]
+    assert manifest["trigger"]["dimension"] == "ttft"
+    assert manifest["metrics"]["before"] is not None  # the periodic ring
+    assert manifest["metrics"]["now"]["counters"]["incident_triggers_total"] == 1
+    assert manifest["sources"]["health"] == {"ready": True, "queue_depth": 2}
+    assert "RuntimeError: probe died" in manifest["sources"]["kv_pool"]["error"]
+    rows = read_events_jsonl(os.path.join(first, "spans.jsonl"))
+    assert len(rows) == 4  # keep_spans bounds the ring slice
+    assert rows[0]["span"] == "serving.request"
+    # each bundle emits one incident.dump event — the events.jsonl join key
+    dumps = tracer.spans("incident.dump")
+    assert [d.attrs["trigger"] for d in dumps] == [
+        "slo_breach", "replica_failure", "slo_breach"
+    ]
+    assert dumps[0].attrs["bundle"] == os.path.basename(first)
+    stats = rec.stats()
+    assert stats["bundles"] == 3 and stats["sources"] == ["health", "kv_pool"]
+    # a restarted process over the same dir resumes the sequence past the
+    # previous run's bundles — the first new dump must not collide
+    rec2 = FlightRecorder(rec.dir, registry=MetricsRegistry(), clock=clock)
+    fourth = rec2.trigger("manual", "post-restart capture")
+    assert fourth is not None and fourth.endswith("incident-004-manual")
+    assert sorted(os.listdir(rec.dir)) == [
+        "incident-001-slo_breach", "incident-002-replica_failure",
+        "incident-003-slo_breach", "incident-004-manual",
+    ]
+
+
+def test_flight_recorder_trigger_never_raises(tmp_path, monkeypatch):
+    """An incident capture failing must not compound the incident: a dump
+    that blows up is counted, returns None, and gives the kind its
+    cooldown back so the NEXT occurrence can still capture."""
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    rec = FlightRecorder(str(tmp_path / "inc"), registry=reg, clock=clock)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(rec, "_dump", boom)
+    assert rec.trigger("pool_exhausted", "no blocks") is None
+    assert reg.counter("incident_dump_errors_total") == 1
+    monkeypatch.undo()
+    # the failed attempt did not burn the cooldown slot
+    assert rec.trigger("pool_exhausted", "no blocks, take 2") is not None
+    assert reg.counter("incident_bundles_total") == 1
+    with pytest.raises(ValueError):
+        FlightRecorder(str(tmp_path / "v"), max_bundles=0)
+    with pytest.raises(ValueError):
+        FlightRecorder(str(tmp_path / "v"), cooldown_s=-1.0)
+
+
+def test_slo_breach_fires_the_recorder_once_per_transition(tmp_path):
+    """The SLOMonitor seam: a breach transition dumps one bundle; polls
+    while still breached do not re-trigger; trigger counters and HELP
+    text exist for every incident_*/tracing_* family."""
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    rec = FlightRecorder(str(tmp_path / "inc"), registry=reg, clock=clock,
+                         cooldown_s=0.0)
+    mon = SLOMonitor(
+        SLOPolicy(ttft_p95_ms=100.0), clock=clock, registry=reg,
+        flight_recorder=rec, fast_window_s=10.0, slow_window_s=50.0,
+        min_samples=3,
+    )
+    for _ in range(10):
+        mon.observe_ttft(500.0)
+        clock.advance(1.0)
+    mon.poll()
+    assert len(rec.bundles) == 1
+    mon.poll()  # still breached: a poll is not a new transition
+    assert len(rec.bundles) == 1
+    with open(os.path.join(rec.bundles[0], "manifest.json")) as fh:
+        trig = json.load(fh)["trigger"]
+    assert trig["kind"] == "slo_breach" and trig["dimension"] == "ttft"
+    assert trig["burn_fast"] >= 2.0
+    for family in (
+        "incident_triggers_total", "incident_bundles_total",
+        "incident_suppressed_total", "incident_dump_errors_total",
+        "tracing_spans_total", "tracing_spans_kept_total",
+        "tracing_spans_sampled_out_total", "tracing_traces_kept_total",
+        "tracing_traces_sampled_out_total",
+    ):
+        assert family in HELP_TEXT, family
+
+
+# -- `obs incident` over the checked-in fixture -----------------------------
+def test_incident_report_pinned_over_fixture_bundle():
+    """The checked-in bundle renders with pinned values (fixture schema
+    drift fails loudly) — trigger header, causal timeline, the exact
+    TTFT decomposition, counter movement, and captured state."""
+    text = report_mod.run_incident("tests/fixtures/incident")
+    assert "trigger: slo_breach  seq=1  spans=9" in text
+    assert "trace ids: t000101, t000102" in text
+    assert "slo.breach" in text and "fleet.replica_failed" in text
+    # worst request first; components telescope exactly (unattrib 0.00)
+    head, worst = None, None
+    for line in text.splitlines():
+        if line.startswith("t000102"):
+            worst = line.split()
+    assert worst is not None
+    assert worst[1:] == ["80.00", "15.00", "25.00", "30.00", "10.00", "-",
+                         "0.00", "ok"]
+    assert "worst decomposed request = 80.0 ms (registry max 80.0 ms)" in text
+    assert "slo_breach_total" in text  # counter movement section
+    assert "frees_by_cause={'retire': 3}" in text
+    analysis = json.loads(
+        report_mod.run_incident("tests/fixtures/incident", as_json=True)
+    )
+    row = analysis["decomposition"][0]
+    assert row["trace_id"] == "t000102" and row["unattributed_ms"] == 0.0
+    assert sum(row["components"].values()) == row["ttft_ms"] == 80.0
+    # a non-bundle manifest is refused, not misread
+    with pytest.raises(ValueError, match="incident-bundle-v1"):
+        report_mod.load_bundle("tests/fixtures/metrics_snapshot.json")
+
+
+# -- THE acceptance drill ---------------------------------------------------
+def test_incident_chaos_drill_end_to_end(tiny_model, tmp_path):
+    """FakeClock chaos run: a replica crash mid-decode during an SLO
+    breach. Pins the PR's acceptance criteria: exactly one bundle per
+    trigger kind inside the cooldown, bundle trace ids join
+    events.jsonl, the analyzer's TTFT decomposition telescopes exactly
+    to the registry's recorded serving_ttft_ms for the worst request,
+    10% sampling keeps 100% of non-ok terminal traces, events.jsonl
+    stays under its byte bound, and the tracing_* counters reconcile."""
+    model, params = tiny_model
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    events_path = str(tmp_path / "events.jsonl")
+    max_bytes = 256 * 1024
+    sampler = SamplingSpanSink(
+        JsonlSpanSink(events_path, max_bytes=max_bytes),
+        rate=0.1, registry=reg,
+    )
+    tracer = Tracer(clock=clock, sink=sampler)
+    rec = FlightRecorder(
+        str(tmp_path / "incidents"), tracer=tracer, registry=reg,
+        clock=clock, cooldown_s=3600.0, max_bundles=8, keep_spans=256,
+        snapshot_every_s=0.5,
+    )
+    mon = SLOMonitor(
+        SLOPolicy(ttft_p95_ms=50.0), clock=clock, registry=reg,
+        tracer=tracer, flight_recorder=rec,
+        fast_window_s=5.0, slow_window_s=20.0, min_samples=3,
+    )
+    chaos = ChaosRegistry()
+
+    def factory():
+        # the shared tracer, exactly like the CLI's serve wiring: engine
+        # spans (slot_assigned / first_token / terminal) carry the fleet
+        # trace ids, which is what the TTFT decomposition reads
+        return SlotServingEngine(
+            model, params, _gcfg(),
+            BucketTable(prompt_lens=(8,), batch_sizes=(1,)),
+            slots=2, clock=clock, tracer=tracer, rng=jax.random.PRNGKey(1),
+        )
+
+    fleet = FleetRouter(
+        [factory] * 2, clock=clock, registry=reg, tracer=tracer,
+        chaos=chaos, slo_monitor=mon, flight_recorder=rec,
+        # no redispatch budget: the crash's victims fail TERMINALLY, so
+        # their non-ok traces are tail-kept on disk (the join evidence)
+        redispatch_policy=RetryPolicy(max_retries=0, backoff_base_s=0.0),
+    )
+    rec.add_source("health", fleet.health)
+    rng = np.random.default_rng(0)
+
+    def prompt():
+        return rng.integers(1, 80, size=8).astype(np.int32)
+
+    def drain():
+        while fleet.pending():
+            fleet.step()
+            rec.maybe_record()
+            clock.advance(0.01)
+        fleet.step()
+
+    # phase 1 — healthy traffic (the recorder's "before" evidence)
+    for _ in range(4):
+        fleet.submit(prompt())
+    drain()
+    assert rec.bundles == []
+    # phase 2 — the incident: requests age past the TTFT target while a
+    # crash is scripted for replica 0's 2nd upcoming step — mid-decode,
+    # after its phase-2 work is resident (`at_step` is an absolute 1-based
+    # per-site count, so arm relative to the steps phase 1 consumed)
+    steps_so_far = chaos._counters.get("fleet.replica_step.0", 0)
+    chaos.crash_replica(0, steps_so_far + 2)
+    victims = [fleet.submit(prompt()) for _ in range(4)]
+    clock.advance(1.0)
+    drain()
+    assert chaos.fired_count("fleet.replica_step.0") == 1
+    assert mon.breached
+    # phase 3 — more traffic inside the cooldown: NO additional bundles
+    for _ in range(3):
+        fleet.submit(prompt())
+    drain()
+
+    kinds = sorted(os.path.basename(b).split("-", 2)[2] for b in rec.bundles)
+    assert kinds == ["replica_failure", "slo_breach"]  # exactly one each
+    assert reg.counter("incident_bundles_total") == 2
+    assert reg.counter("incident_triggers_total") >= 2
+    failed = [r for r in victims if r.status == "failed"]
+    assert failed  # the crash terminally failed its in-flight victims
+    sampler.close()
+
+    # -- join: bundle trace ids <-> events.jsonl ----------------------------
+    assert os.path.getsize(events_path) <= max_bytes
+    rows = read_events_jsonl(events_path)
+    disk_traces = {r["trace_id"] for r in rows if r.get("trace_id")}
+    crash = next(b for b in rec.bundles if b.endswith("replica_failure"))
+    with open(os.path.join(crash, "manifest.json")) as fh:
+        crash_manifest = json.load(fh)
+    victim_tids = crash_manifest["trigger"]["trace_ids"]
+    assert set(victim_tids) == {r.trace_id for r in failed}
+    assert set(victim_tids) <= disk_traces  # non-ok -> tail-kept on disk
+    # every bundle's incident.dump event landed on disk (never sampled)
+    dump_rows = [r for r in rows if r["span"] == "incident.dump"]
+    assert {r["attrs"]["bundle"] for r in dump_rows} == {
+        os.path.basename(b) for b in rec.bundles
+    }
+    # the crash bundle's span slice contains its own victims' spans
+    bundle_rows = read_events_jsonl(os.path.join(crash, "spans.jsonl"))
+    assert set(victim_tids) <= {
+        r["trace_id"] for r in bundle_rows if r.get("trace_id")
+    }
+    # sampling kept 100% of non-ok terminal traces (ring = ground truth)
+    bad_traces = {
+        s.trace_id for s in tracer.finished
+        if s.status in TAIL_KEEP_STATUSES and s.trace_id
+    }
+    assert bad_traces and bad_traces <= disk_traces
+    c = reg.counters()
+    assert (
+        c["tracing_spans_kept_total"] + c["tracing_spans_sampled_out_total"]
+        == c["tracing_spans_total"]
+    )
+    assert c["tracing_spans_sampled_out_total"] > 0  # sampling did sample
+    # HELP coverage (the test_slo/test_gateway idiom, extended to the new
+    # families): every family this drill published has a direct entry
+    snap = reg.snapshot()
+    published = (
+        set(snap["counters"]) | set(snap["gauges"]) | set(snap["histograms"])
+    )
+    assert {"tracing_spans_total", "incident_bundles_total"} <= published
+    # tracing_*/incident_* get DIRECT entries; per-dimension slo_* families
+    # ride the documented prefix fallback
+    assert all(n in HELP_TEXT for n in published
+               if n.startswith(("tracing_", "incident_")))
+    missing = sorted(n for n in published if help_text(n) is None)
+    assert not missing, f"families without HELP: {missing}"
+
+    # -- the analyzer: decomposition telescopes exactly ---------------------
+    # end-of-run operator capture: every terminal has landed by now
+    final = rec.trigger("manual", "post-drill analyzer capture")
+    assert final is not None
+    analysis = json.loads(report_mod.run_incident(final, as_json=True))
+    decomp = analysis["decomposition"]
+    assert decomp, "no serving.first_token spans reached the bundle"
+    worst = decomp[0]
+    ttft_hist = reg.snapshot()["histograms"]["serving_ttft_ms"]
+    assert worst["ttft_ms"] == round(ttft_hist["max"], 3)
+    assert worst["ttft_ms"] >= 1000.0  # the aged phase-2 cohort
+    for row in decomp:
+        assert row["unattributed_ms"] == 0.0, row
+        assert round(sum(row["components"].values()), 3) == row["ttft_ms"]
+    # the aged cohort's survivors decompose into the FULL critical path
+    full = [
+        r for r in decomp if set(r["components"]) == {
+            "front_door_ms", "queue_ms", "prefill_ms", "first_step_ms"
+        }
+    ]
+    assert full and max(r["ttft_ms"] for r in full) >= 1000.0
+    assert analysis["ttft"]["max_ms"] == ttft_hist["max"]
+    # the rendered report carries the incident narrative
+    text = report_mod.format_incident_report(analysis)
+    assert "per-request ttft decomposition" in text
+    assert "causal timeline" in text
+    assert "fleet.replica_failed" in text or "slo.breach" in text

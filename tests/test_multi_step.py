@@ -47,6 +47,7 @@ def _batches(n, batch_size=8, seed=0):
     return out
 
 
+@pytest.mark.slow  # 16-19s: heaviest tier-1 entries (2026-08 runtime audit)
 def test_multi_step_matches_sequential():
     model, cfg = tiny_clm()
     mesh = make_mesh(MeshConfig(data=2))
@@ -95,6 +96,7 @@ def test_multi_step_matches_sequential():
     )
 
 
+@pytest.mark.slow  # 16-19s: heaviest tier-1 entries (2026-08 runtime audit)
 def test_trainer_steps_per_execution_matches_single(tmp_path):
     model, cfg = tiny_clm()
     prefix_len = SEQ - LATENTS
@@ -146,6 +148,7 @@ def test_trainer_steps_per_execution_matches_single(tmp_path):
     )
 
 
+@pytest.mark.slow  # 16-19s: heaviest tier-1 entries (2026-08 runtime audit)
 def test_multi_step_composes_with_grad_accum():
     """grad_accum_steps × multi_steps in one jitted program equals the
     sequential accumulated steps (the flagship clm.sh config uses both)."""

@@ -153,6 +153,7 @@ def test_allocator_schedule_determinism_under_fakeclock(tiny_model):
 
 
 # -- greedy token parity ----------------------------------------------------
+@pytest.mark.slow  # 16s; still in the `-m paged_kv` lane (runtime audit)
 def test_paged_parity_mid_flight_admit_boundary_recycled(tiny_model):
     """5 ragged requests through 2 paged slots: mid-flight admits into
     recycled slots, rows crossing the latent boundary at different steps

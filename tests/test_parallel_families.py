@@ -133,9 +133,12 @@ def baselines():
     return {fam: run_steps(fam, MeshConfig(data=1))[0] for fam in FAMILIES}
 
 
+# 2026-08 runtime audit: the single-axis 8-way meshes cost 9-13s per
+# family and re-prove axes the composed dp2xfsdp2xtp2 case (kept in
+# tier-1) already exercises together — they stay as `slow` depth.
 MESHES = [
-    MeshConfig(data=8),
-    MeshConfig(data=1, fsdp=8),
+    pytest.param(MeshConfig(data=8), marks=pytest.mark.slow),
+    pytest.param(MeshConfig(data=1, fsdp=8), marks=pytest.mark.slow),
     MeshConfig(data=2, fsdp=2, model=2),
 ]
 MESH_IDS = ["dp8", "fsdp8", "dp2xfsdp2xtp2"]

@@ -97,6 +97,7 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path, straight_9):
     _assert_states_equal(straight, resumed)
 
 
+@pytest.mark.slow  # 15s; the plain kill/resume variant covers tier-1 (runtime audit)
 def test_kill_and_resume_with_fused_blocks_matches(tmp_path, straight_9):
     """Resume composes with steps_per_execution: a run killed at a snapshot
     and resumed with fused 3-step blocks must replay the identical

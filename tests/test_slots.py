@@ -323,6 +323,7 @@ def test_serve_cli_slots_engine(tmp_path):
         clm_script.main(common + ["--serve.engine=nope"])
 
 
+@pytest.mark.slow  # 12s bench probe; `make serve-bench` is its real lane (runtime audit)
 def test_bench_serve_ab_probe_tiny(tiny_model):
     """The bench.py slots-vs-bucket A/B runs at a pure-CPU tiny shape and
     records both engines' tokens/s, the speedup ratio, slot occupancy, and
