@@ -180,6 +180,23 @@ prefix-bench:
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'prefix_cache': bench._bench_prefix_cache(model, params, cfg)}, indent=2))"
 
+# sharded serving-runtime suite (docs/serving.md "Sharded serving"):
+# 1-device byte parity, 8-virtual-device token parity across dense/paged/
+# chunked/prefix-shared geometries, mesh-keyed executor identity + ledger
+# attribution, zero-leak cancel/evacuate drills — CPU-fast, also tier-1
+sharded:
+	$(PY) -m pytest tests/ -q -m sharded --continue-on-collection-errors
+
+# sharded serving A/B: the self-contained probe subprocessed at 1 device
+# vs a 2x4 mesh over 8 virtual CPU devices (XLA_FLAGS-injected) — tokens/s,
+# compile counts, per-model-shard resident KV bytes, token-identity pin
+shard-bench:
+	$(PY) -c "import json; \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	print(json.dumps({'sharded_serving': bench._bench_sharded_serving()}, indent=2))"
+
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

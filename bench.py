@@ -685,6 +685,25 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "incident": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: sharded serving A/B (1-device vs 8-virtual-device mesh) ----
+        if left() > 150.0:
+            log("run: sharded serving probe (1-device vs 2x4 CPU mesh A/B)")
+            try:
+                shd = _bench_sharded_serving(budget_s=min(240.0, left() - 30.0))
+                res.update(extras={**res.data["extras"], "sharded_serving": shd})
+                log(f"run: sharded serving {shd['sharded']['mesh']['data']}x"
+                    f"{shd['sharded']['mesh']['model']} mesh "
+                    f"{shd['sharded']['tokens_per_s']} tok/s vs single "
+                    f"{shd['single']['tokens_per_s']} tok/s "
+                    f"(speedup {shd['speedup']}, token_identical="
+                    f"{shd['token_identical']}, per-shard resident "
+                    f"{shd['sharded']['per_shard_resident_bytes']} B)")
+            except Exception as e:
+                log(f"run: sharded serving probe failed "
+                    f"({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "sharded_serving": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # BENCH_* records carry the process-wide telemetry snapshot AND the
         # device-cost ledger (per-executor compile/memory/retrace table;
         # docs/observability.md) — every BENCH_* file is `obs report`-able.
@@ -2371,6 +2390,66 @@ def _bench_incident(model, params, cfg, *, n_requests: int = 4,
         "worst_request": decomp[0] if decomp else None,
         "timeline_events": len(analysis["timeline"]),
         "bundle_dir": recorder.dir,
+    }
+
+
+def _bench_sharded_serving(*, requests: int = 8, new_tokens: int = 8,
+                           slots: int = 4, budget_s: float = 240.0):
+    """Sharded-serving A/B (docs/serving.md "Sharded serving"): the
+    self-contained probe (``python -m perceiver_io_tpu.serving.sharding``)
+    runs twice in child processes — a 1-device single mesh and a
+    2 data x 4 model mesh over 8 virtual CPU devices, the device count
+    injected per child via ``XLA_FLAGS`` (the same simulation strategy the
+    test suite uses) — on identical seeded paged workloads. The record
+    A/Bs tokens/s, compile counts, and per-model-shard resident KV bytes,
+    and pins ``token_identical``: greedy output must not move when GSPMD
+    partitions the executors. ``make shard-bench`` is the one-command
+    form; tier-1 pins the same parity in-process (tests/test_sharding.py).
+    """
+    import json as _json
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    base_args = [
+        "--slots", str(slots), "--requests", str(requests),
+        "--new-tokens", str(new_tokens), "--kv-layout", "paged",
+    ]
+
+    def probe(device_count: int, data: int, model_axis: int, timeout: float):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={device_count}"
+        ).strip()
+        proc = subprocess.run(
+            [sys.executable, "-m", "perceiver_io_tpu.serving.sharding",
+             "--data", str(data), "--model", str(model_axis), *base_args],
+            env=env, cwd=repo_root, stdout=subprocess.PIPE,
+            stderr=sys.stderr, text=True, timeout=timeout,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"shard probe ({data}x{model_axis}@{device_count}dev) "
+                f"exited rc={proc.returncode}"
+            )
+        return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+    keep = ("devices", "mesh", "kv_layout", "compile_count",
+            "tokens_generated", "tokens_per_s", "wall_s", "resident_bytes",
+            "per_shard_resident_bytes")
+    single = probe(1, 1, 1, timeout=budget_s / 2)
+    sharded = probe(8, 2, 4, timeout=budget_s / 2)
+    return {
+        "workload": {"requests": requests, "new_tokens": new_tokens,
+                     "slots": slots},
+        "single": {k: single[k] for k in keep},
+        "sharded": {k: sharded[k] for k in keep},
+        # tiny CPU shapes are dispatch/collective-bound, so no winner is
+        # asserted — the ratio and the per-shard bytes are the record
+        "speedup": round(
+            sharded["tokens_per_s"] / max(single["tokens_per_s"], 1e-9), 3
+        ),
+        "token_identical": single["tokens"] == sharded["tokens"],
     }
 
 

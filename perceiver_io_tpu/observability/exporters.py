@@ -79,6 +79,10 @@ HELP_TEXT = {
     "hbm_bytes_in_use": "Live device memory from memory_stats() (absent on CPU).",
     "kv_cache_resident_bytes": "Live slot-KV bytes: allocated pages + latent-stack caches under the paged layout; equals capacity when dense.",
     "kv_cache_capacity_bytes": "Worst-case slot-KV bytes: dense per-slot caches at full context + latent-stack caches.",
+    "kv_cache_resident_bytes_per_shard": "Model-axis shard of the live KV bytes on a sharded serving mesh (docs/serving.md \"Sharded serving\").",
+    "serving_mesh_devices": "Devices claimed by the engine's serving mesh (data x model); absent when serving unsharded.",
+    "serving_mesh_data": "Serving-mesh data-axis size (slot/batch parallelism).",
+    "serving_mesh_model": "Serving-mesh model-axis size (attention-head / KV tensor parallelism).",
     "kv_pool_blocks": "Usable KV pool capacity in blocks (null block excluded).",
     "kv_pool_blocks_in_use": "Pool blocks currently mapped to live token positions.",
     "kv_pool_blocks_reserved": "Pool blocks reserved by resident requests' worst cases (mapped or not).",

@@ -158,6 +158,7 @@ def analyze(events: List[dict], snapshot: Optional[dict] = None) -> dict:
         "padding": padding,
         "fleet": _fleet_section(events, snapshot),
         "kv_pool": _kv_pool_section(snapshot),
+        "sharding": _sharding_section(snapshot),
         "slo": _slo_section(events, snapshot),
         "gateway": _gateway_section(events, snapshot),
         "elasticity": _elasticity_section(events, snapshot),
@@ -485,6 +486,44 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
         "resident_bytes": g("kv_cache_resident_bytes"),
         "capacity_bytes": g("kv_cache_capacity_bytes"),
         "prefix_cache": prefix,
+    }
+
+
+def _sharding_section(snapshot: dict) -> Optional[dict]:
+    """Sharded-serving rollup (docs/serving.md "Sharded serving"): the mesh
+    shape from the ``serving_mesh_*`` gauges, per-shard vs total live KV
+    bytes, and the mesh-attributed retrace accounting — the
+    ``retrace_reason_mesh_total`` counter plus the distinct ``mesh``
+    components in the compile ledger (a mesh flip rebuilds; a reuse would
+    show zero here and a stale single-device executor in production). None
+    when the run served unsharded — pre-mesh artifacts stay unchanged."""
+    gauges = snapshot.get("gauges") or {}
+    counters = snapshot.get("counters") or {}
+    devices = gauges.get("serving_mesh_devices")
+    if devices is None:
+        return None
+
+    def g(name):
+        v = gauges.get(name)
+        return None if v is None else int(v)
+
+    ledger = snapshot.get("compile_ledger") or {}
+    meshes = sorted({
+        str((rec.get("components") or {}).get("mesh"))
+        for rec in ledger.get("records") or []
+        if (rec.get("components") or {}).get("mesh")
+    })
+    resident = g("kv_cache_resident_bytes")
+    per_shard = g("kv_cache_resident_bytes_per_shard")
+    retraces = counters.get("retrace_reason_mesh_total")
+    return {
+        "devices": int(devices),
+        "data": g("serving_mesh_data"),
+        "model": g("serving_mesh_model"),
+        "resident_bytes": resident,
+        "per_shard_resident_bytes": per_shard,
+        "mesh_retraces": None if retraces is None else int(retraces),
+        "ledger_meshes": meshes,
     }
 
 
@@ -868,6 +907,31 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"evicted={pc['evicted_blocks']} cow={pc['cow_copies']} "
                 f"cached_now={pc['cached_blocks']}"
             )
+
+    mesh = analysis.get("sharding")
+    if mesh:
+        out.append("")
+        out.append("== sharded serving ==")
+        shape = (
+            f"{mesh['data']}x{mesh['model']}"
+            if mesh["data"] is not None and mesh["model"] is not None
+            else "?"
+        )
+        out.append(f"mesh: {shape} over {mesh['devices']} devices")
+        if mesh["resident_bytes"] is not None:
+            per = mesh["per_shard_resident_bytes"]
+            out.append(
+                f"kv resident: {mesh['resident_bytes']:,} B total"
+                + (f", {per:,} B per model shard" if per is not None else "")
+            )
+        out.append(
+            f"mesh-attributed retraces: "
+            f"{mesh['mesh_retraces'] if mesh['mesh_retraces'] is not None else 0}"
+            + (
+                "  ledger meshes: " + ", ".join(mesh["ledger_meshes"])
+                if mesh["ledger_meshes"] else ""
+            )
+        )
 
     gw = analysis.get("gateway")
     if gw:

@@ -33,11 +33,40 @@ decode-attention op over that layout, in two implementations:
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+#: trace-time sharding hint for the gather path's transient dense view
+#: (docs/serving.md "Sharded serving"): the sharded slot engine sets it
+#: around its decode executors' trace so the gathered (b, h, n, d) k/v
+#: stay slot-sharded along ``data`` and head-sharded along ``model`` —
+#: the attend computes shard-local and only the o-projection all-reduces
+#: (the ``sharded_paged_attention`` shape, derived by GSPMD instead of a
+#: hand-written shard_map). None (the default) changes nothing.
+_GATHER_SHARDING: contextvars.ContextVar = contextvars.ContextVar(
+    "paged_gather_sharding", default=None
+)
+
+
+@contextlib.contextmanager
+def gather_constraint(sharding):
+    """Install a ``NamedSharding`` constraint for the paged gather's dense
+    view during an executor trace (no-op for ``None``). Trace-time only:
+    the constraint is baked into the jitted program, so the context needs
+    to be live when the executor's Python body runs, not per dispatch."""
+    if sharding is None:
+        yield
+        return
+    token = _GATHER_SHARDING.set(sharding)
+    try:
+        yield
+    finally:
+        _GATHER_SHARDING.reset(token)
 
 #: trace-time env flag enabling the Pallas TPU kernel path (see module
 #: docstring; folded into ``modules.trace_env_fingerprint``)
@@ -83,14 +112,42 @@ def flat_write_indices(table: jnp.ndarray, positions: jnp.ndarray,
     return table[rows, positions // block_size] * block_size + positions % block_size
 
 
+def _constrain_gather(x: jnp.ndarray) -> jnp.ndarray:
+    """Apply the installed :func:`gather_constraint` to one gathered dense
+    view, dropping any dim the constraint cannot shard (a batch-1 prefill
+    gather keeps its heads sharded while its slot dim replicates)."""
+    constraint = _GATHER_SHARDING.get()
+    if constraint is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh, spec = constraint.mesh, constraint.spec
+    dims = []
+    for i in range(x.ndim):
+        axis = spec[i] if i < len(spec) else None
+        size = int(mesh.shape.get(axis, 1)) if axis is not None else 1
+        dims.append(axis if size > 1 and x.shape[i] % size == 0 else None)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*dims))
+    )
+
+
 def gather_kv(pool: jnp.ndarray, flat_idx: jnp.ndarray) -> jnp.ndarray:
     """Gather pool rows into a dense per-slot view.
+
+    Every caller — the decode step below, the boundary-phase step and the
+    prefill finalize in ``inference/generate.py`` — flows through here, so
+    the :func:`gather_constraint` sharding hint covers ALL paged gathers:
+    on a serving mesh the transient view stays slot/head-sharded instead
+    of all-gathering the model-sharded pool.
 
     :param pool: ``(pool_tokens, h, d)`` flat token-major pool.
     :param flat_idx: ``(b, n)`` indices from :func:`flat_position_indices`.
     :return: ``(b, h, n, d)`` dense view (transient).
     """
-    return jnp.take(pool, flat_idx, axis=0).transpose(0, 2, 1, 3)
+    return _constrain_gather(
+        jnp.take(pool, flat_idx, axis=0).transpose(0, 2, 1, 3)
+    )
 
 
 def paged_decode_attention(
@@ -128,7 +185,7 @@ def paged_decode_attention(
         if out is not None:
             return out
     flat = flat_position_indices(table, block_size, n)
-    k = gather_kv(pool_k, flat)
+    k = gather_kv(pool_k, flat)  # gather_constraint applies inside
     v = gather_kv(pool_v, flat)
     return attend(q, k, v, pad_mask=pad_mask, deterministic=True)
 

@@ -104,8 +104,37 @@ def make_mesh(
     return Mesh(device_array, AXIS_NAMES)
 
 
-def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+def device_slice(
+    count: int,
+    *,
+    offset: int = 0,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list:
+    """A contiguous device subset — the serving-fleet call shape: N replicas
+    each own ``count`` devices at disjoint offsets (``serving/sharding.py``),
+    so replicas=2 × mesh-of-4 coexist in one process instead of every mesh
+    claiming ``jax.devices()`` whole. Validates the slice actually exists —
+    an over-subscribed fleet must fail at construction, not alias devices
+    silently."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if count < 1:
+        raise ValueError(f"device_slice count must be >= 1, got {count}")
+    if offset < 0:
+        raise ValueError(f"device_slice offset must be >= 0, got {offset}")
+    if offset + count > len(devices):
+        raise ValueError(
+            f"device slice [{offset}, {offset + count}) overruns the "
+            f"{len(devices)} available devices — shrink the mesh or the "
+            "replica count (replicas x data x model devices must fit)"
+        )
+    return devices[offset:offset + count]
+
+
+def single_device_mesh(device: Optional[jax.Device] = None,
+                       *, index: int = 0) -> Mesh:
     """Degenerate 1-device mesh so the same sharded train step runs on one
-    chip (all axes size 1 — every PartitionSpec collapses to replication)."""
-    device = device or jax.devices()[0]
+    chip (all axes size 1 — every PartitionSpec collapses to replication).
+    ``index`` picks the device when none is passed — the serving-replica
+    form of "use this device subset" (:func:`device_slice`) at size 1."""
+    device = device if device is not None else device_slice(1, offset=index)[0]
     return make_mesh(MeshConfig(data=1), devices=[device])

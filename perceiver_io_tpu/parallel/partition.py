@@ -28,7 +28,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from perceiver_io_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL, AXIS_SEQ, BATCH_AXES
+from perceiver_io_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    BATCH_AXES,
+)
 
 # (path regex, dim) — dim of the kernel to shard over the `model` axis.
 # Column-parallel (output dim): q/k/v projections, MLP up-projection.
@@ -120,6 +126,65 @@ def shard_params(params, mesh: Mesh):
     """Place a (host or single-device) param pytree onto the mesh according
     to the inferred specs — the moment FSDP materializes its shards."""
     return jax.device_put(params, param_shardings(params, mesh))
+
+
+# -- serving KV / slot-state rules (docs/serving.md "Sharded serving") ------
+#
+# The slot engine's persistent decode state (``serving/slots.py``) is the
+# serving-side analogue of the param tree: named leaves with fixed layouts.
+# The rules mirror the Megatron TP discipline above — attention heads (and
+# everything keyed by them: dense per-slot caches, the paged pool's flat
+# ``pool_k``/``pool_v``, the chunked-prefill staging caches) shard along
+# ``model``; the slot/batch dimension shards along ``data``. Pool arrays are
+# deliberately NOT data-sharded: block tables address one shared pool, so
+# every data shard must see every page (sharing the pool across slots is the
+# paged layout's whole point). A dimension that does not divide its axis
+# falls back to replication on that dimension — same stance as the FSDP
+# rule's small-leaf fallback.
+#
+# (name regex, per-dim axis template). Longest/most-specific first; matched
+# against the leaf's path ("stack_k/0" for tuple entries).
+SERVING_STATE_RULES: Tuple[Tuple[str, Tuple], ...] = (
+    # (pool_tokens, heads, head_dim): shared across slots, heads sharded
+    (r"^(pool_k|pool_v)$", (None, AXIS_MODEL, None)),
+    # (1, heads, n, head_dim) batch-1 staging caches (chunked prefill)
+    (r"^(stage_k|stage_v)$", (None, AXIS_MODEL, None, None)),
+    # (slots, heads, n, head_dim) dense per-slot caches
+    (r"^(cross_k|cross_v|stack_k|stack_v)(/\d+)?$",
+     (AXIS_DATA, AXIS_MODEL, None, None)),
+    # (slots, n) / (slots, vocab) / (slots, pages)
+    (r"^(window|logits|table)$", (AXIS_DATA, None)),
+    # (slots,) per-row vectors (and the decode step's token output)
+    (r"^(pad|length|m|steps|tokens)$", (AXIS_DATA,)),
+)
+
+
+def serving_state_spec(name: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """PartitionSpec for one slot-state leaf by name. Unknown names and
+    non-divisible dimensions replicate — the safe default; the sharded
+    serving layer validates the load-bearing divisibilities (slots % data,
+    heads % model) loudly at engine construction instead."""
+    for pattern, template in SERVING_STATE_RULES:
+        if re.search(pattern, name):
+            spec: list = [None] * len(shape)
+            for dim, axis in enumerate(template[: len(shape)]):
+                if axis is None:
+                    continue
+                size = mesh.shape.get(axis, 1)
+                if size > 1 and shape[dim] % size == 0:
+                    spec[dim] = axis
+            return P(*spec)
+    return P()
+
+
+def serving_state_specs(state, mesh: Mesh):
+    """Pytree of PartitionSpecs matching a slot-engine state dict."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, v: serving_state_spec(
+            _flatten_path(kp), tuple(np.shape(v)), mesh
+        ),
+        state,
+    )
 
 
 def batch_spec(

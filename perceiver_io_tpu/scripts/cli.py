@@ -176,6 +176,29 @@ class HttpArgs:
 
 
 @dataclasses.dataclass
+class MeshServeArgs:
+    """``--serve.mesh.*``: the sharded serving runtime (docs/serving.md
+    "Sharded serving"). Passing any ``--serve.mesh.*`` flag compiles the
+    slot engine's executors over a ``data`` × ``model`` device mesh:
+    slots/batch shard along ``data`` (``--serve.slots`` must divide
+    evenly), attention heads and KV caches — dense per-slot AND the paged
+    pool — along ``model`` (the model's head count must divide evenly),
+    params get the Megatron TP placement. With ``--serve.replicas=N``
+    each replica claims the next disjoint ``data×model`` device group, so
+    the fleet scales as N replicas × M-device replicas. Greedy output
+    stays token-identical to the unsharded engine; a 1×1 mesh reproduces
+    it exactly."""
+
+    #: slot/batch-parallel axis size
+    data: int = 1
+    #: tensor-parallel axis size (attention heads, KV caches)
+    model: int = 1
+    #: index of the first claimed device — replica i of a fleet starts at
+    #: ``device_offset + i * data * model``
+    device_offset: int = 0
+
+
+@dataclasses.dataclass
 class AutoscaleArgs:
     """``--serve.autoscale.*``: SLO-driven fleet elasticity
     (docs/serving.md "Elasticity"). Setting ``--serve.autoscale.max``
@@ -305,6 +328,10 @@ class ServeArgs:
     #: the ``--serve.autoscale.*`` sub-group: SLO-driven fleet elasticity
     #: (docs/serving.md "Elasticity"); off unless ``autoscale.max`` set
     autoscale: AutoscaleArgs = dataclasses.field(default_factory=AutoscaleArgs)
+    #: the ``--serve.mesh.*`` sub-group: sharded serving over the
+    #: parallelism mesh (docs/serving.md "Sharded serving"); off unless a
+    #: mesh flag is passed (slots engine only)
+    mesh: MeshServeArgs = dataclasses.field(default_factory=MeshServeArgs)
 
 
 def _serve_decode_mode(flag_value: str) -> str:
@@ -1090,6 +1117,40 @@ class CLI:
             kv_mode = _serve_kv_layout(args.kv_layout)
             prefix_mode = _serve_prefix_cache(args.prefix_cache)
             flight_recorder = kit["flight_recorder"]
+            # sharded serving (docs/serving.md "Sharded serving"): any
+            # --serve.mesh.* flag opts in — including an explicit 1x1
+            # degenerate mesh (the byte-identical single-device form)
+            mesh_requested = any(k.startswith("serve.mesh.") for k in values)
+            mesh_alloc = None
+            if mesh_requested:
+                if args.engine != "slots":
+                    raise SystemExit(
+                        "--serve.mesh.* applies to --serve.engine=slots "
+                        "(the sharded runtime compiles the slot engine's "
+                        "executors over the mesh; the bucket engine is "
+                        "single-device)"
+                    )
+                from perceiver_io_tpu.serving import (
+                    MeshGroupAllocator,
+                    ServingMeshSpec,
+                    fleet_mesh_specs,
+                )
+
+                try:
+                    base_spec = ServingMeshSpec(
+                        data=args.mesh.data, model=args.mesh.model,
+                        device_offset=args.mesh.device_offset,
+                    )
+                    # the INITIAL fleet must fit the device budget outright
+                    # (autoscaler spawns past it wrap around the allocator,
+                    # documented on sharding.MeshGroupAllocator)
+                    fleet_mesh_specs(base_spec, max(1, args.replicas))
+                except ValueError as e:
+                    raise SystemExit(f"--serve.mesh.*: {e}")
+                # one shared allocator hands each spawn the first FREE
+                # disjoint device group — initial replicas, crash rebuilds
+                # (the crashed group frees for its rebuild), scale-ups
+                mesh_alloc = MeshGroupAllocator(base_spec)
             if args.engine == "slots":
                 def make_engine():
                     eng = SlotServingEngine(
@@ -1097,6 +1158,10 @@ class CLI:
                         prefill_chunk=args.prefill_chunk,
                         kv_layout=kv_mode, kv_block_size=args.kv_block_size,
                         kv_blocks=args.kv_blocks, prefix_cache=prefix_mode,
+                        mesh=(
+                            mesh_alloc.acquire() if mesh_alloc is not None
+                            else None
+                        ),
                         **engine_kwargs
                     )
                     # inside the factory, not after it: fleet replica
@@ -1459,6 +1524,12 @@ class CLI:
               "--serve.autoscale.scale_up_slots — SLO-driven fleet elasticity: "
               "burn/queue pressure scales replicas up to max, cooldown-gated "
               "zero-downtime scale-down (docs/serving.md)")
+        print("serve mesh: --serve.mesh.data=<n> --serve.mesh.model=<n> "
+              "--serve.mesh.device_offset=<i> — sharded serving over the "
+              "parallelism mesh (slots engine): slots shard along data, "
+              "attention heads + KV caches along model; with replicas each "
+              "replica owns the next disjoint data x model device group "
+              "(docs/serving.md \"Sharded serving\")")
         print("serve http gateway: --serve.http.port=<n|0> --serve.http.host "
               "--serve.http.stream={sse|jsonl} --serve.http.max_streams — "
               "POST /v1/generate streams tokens as they decode; GET /healthz, "

@@ -23,6 +23,13 @@ generation executors.
   end: per-token SSE / JSON-lines streaming out of ``step()``,
   socket-anchored TTFT, and client-disconnect cancellation that frees
   slots and KV pool pages mid-generation.
+- :class:`ServingMeshSpec` — the sharded serving runtime (docs/serving.md
+  "Sharded serving"): the slot engine's executors compile over a
+  ``data`` × ``model`` device mesh (slots sharded along data, attention
+  heads + KV caches — dense and the paged pool — along model), turning
+  "N replicas" into "N replicas × M-device replicas"
+  (:func:`~perceiver_io_tpu.serving.sharding.fleet_mesh_specs` hands each
+  replica a disjoint device subset).
 
 All are hardened for load (docs/reliability.md): bounded queue with
 :class:`QueueFull` backpressure, per-request deadlines, per-request error
@@ -41,6 +48,12 @@ from perceiver_io_tpu.serving.fleet import (
 )
 from perceiver_io_tpu.serving.gateway import StreamingGateway
 from perceiver_io_tpu.serving.kv_pool import KVPagePool, PoolExhausted, PrefixBlockIndex
+from perceiver_io_tpu.serving.sharding import (
+    MeshGroupAllocator,
+    ServingMeshSpec,
+    ServingSharding,
+    fleet_mesh_specs,
+)
 from perceiver_io_tpu.serving.slots import SlotServingEngine
 
 __all__ = [
@@ -58,6 +71,10 @@ __all__ = [
     "Replica",
     "ServeRequest",
     "ServingEngine",
+    "MeshGroupAllocator",
+    "ServingMeshSpec",
+    "ServingSharding",
     "SlotServingEngine",
     "StreamingGateway",
+    "fleet_mesh_specs",
 ]

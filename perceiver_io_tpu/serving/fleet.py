@@ -291,7 +291,17 @@ class Replica:
         """Rebuild the engine from the factory — the crashed-process model:
         queued and resident engine work is lost (the router already failed
         it over), the executor caches are process-global so the fresh
-        engine compiles nothing new."""
+        engine compiles nothing new. A sharded replica's mesh-group claim
+        is released BEFORE the factory runs, so the rebuild reclaims the
+        CRASHED group instead of aliasing a live replica's devices
+        (``serving/sharding.py`` ``MeshGroupAllocator``); the crashed
+        engine itself stays installed until the factory returns, so a
+        spawn failure leaves the replica degraded-but-present, never
+        holding ``engine=None``."""
+        sharding = getattr(self.engine, "sharding", None)
+        release = getattr(sharding, "release", None)
+        if release is not None:
+            release()
         self.engine = self.factory()
         self._install_latency_mirror()
         self.handles.clear()
@@ -330,6 +340,14 @@ class FleetRouter:
         re-invoked to rebuild crashed replicas, must build engines sharing
         the fleet ``clock``, and should build engines WITHOUT their own
         ``max_queue``/``default_deadline_s`` — admission is fleet-level.
+        For a SHARDED fleet (docs/serving.md "Sharded serving") each
+        factory owns a disjoint device subset: build them over
+        :func:`~perceiver_io_tpu.serving.sharding.fleet_mesh_specs` (fixed
+        per-replica offsets) or ``acquire()`` from a
+        :class:`~perceiver_io_tpu.serving.sharding.MeshGroupAllocator`
+        inside one shared factory (what the serve CLI does), so crash
+        rebuilds and autoscaler spawns keep landing on disjoint groups —
+        the N replicas × M-device replicas scaling shape.
     :param clock: the fleet's (and every breaker's) monotonic time source.
     :param chaos: optional :class:`~perceiver_io_tpu.reliability.ChaosRegistry`
         consulted at ``fleet.dispatch`` / ``fleet.replica_step.<r>``.

@@ -129,6 +129,7 @@ from perceiver_io_tpu.inference.samplers import apply_min_new_tokens, sample_log
 from perceiver_io_tpu.ops import paged_attention as paged_ops
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine, _round_ms
 from perceiver_io_tpu.serving.kv_pool import KVPagePool, PrefixBlockIndex
+from perceiver_io_tpu.serving.sharding import as_serving_sharding
 
 _EXECUTOR_CACHE: dict = register_executor_cache({})
 
@@ -138,6 +139,19 @@ def _donate(*argnums: int) -> tuple:
     update on device) — skipped on CPU, where donation is unimplemented and
     only produces a warning per compile."""
     return argnums if jax.default_backend() != "cpu" else ()
+
+
+def _jit(fn, donate: tuple, out_shardings=None):
+    """jit an executor body, optionally pinning its output shardings to the
+    serving mesh (docs/serving.md "Sharded serving"). Pinning matters for
+    trace stability, not just placement: the persistent state round-trips
+    through every executor, so an output GSPMD re-sharded differently from
+    its input would change the next call's committed-input signature and
+    retrace. ``None`` (unsharded engine) is byte-for-byte today's
+    ``jax.jit`` call."""
+    if out_shardings is None:
+        return jax.jit(fn, donate_argnums=donate)
+    return jax.jit(fn, donate_argnums=donate, out_shardings=out_shardings)
 
 
 _STATE_SHAPES: dict = {}  # (model key, param dtypes) -> (logits, cache) shapes
@@ -262,7 +276,8 @@ def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m,
 
 
 def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int,
-                            block_size: Optional[int] = None):
+                            block_size: Optional[int] = None,
+                            out_shardings=None):
     """Prefill one request at prompt bucket ``bucket_len`` and insert its
     caches + row state into slot ``slot`` of the persistent state.
     ``block_size`` selects the paged layout: the executor additionally
@@ -289,7 +304,7 @@ def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int,
                 cache=cache, length=length, m=jnp.asarray(m0, jnp.int32),
             )
 
-        return jax.jit(run, donate_argnums=_donate(4))
+        return _jit(run, _donate(4), out_shardings)
 
     def run_paged(params, ids, pad_count, slot, table_row, state):
         window, pad, logits, cache, length = prefill(params, ids, pad_count)
@@ -299,11 +314,12 @@ def _build_prefill_executor(model, config: GenerationConfig, bucket_len: int,
             table_row=table_row, block_size=block_size,
         )
 
-    return jax.jit(run_paged, donate_argnums=_donate(5))
+    return _jit(run_paged, _donate(5), out_shardings)
 
 
 def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int,
-                                    block_size: Optional[int] = None):
+                                    block_size: Optional[int] = None,
+                                    out_shardings=None):
     """ONE bucket-independent executor for chunked admission, two
     ``lax.cond`` branches in one compiled program. Stage calls project the
     ``kv_norm``-side cross k/v of ``chunk`` prefix token positions into a
@@ -356,11 +372,12 @@ def _build_chunked_prefill_executor(model, config: GenerationConfig, chunk: int,
 
         return jax.lax.cond(is_final, fin, stage, (stage_k, stage_v, state))
 
-    return jax.jit(run, donate_argnums=_donate(9, 10, 11))
+    return _jit(run, _donate(9, 10, 11), out_shardings)
 
 
 def _build_shared_prefill_executor(model, config: GenerationConfig, chunk: int,
-                                   block_size: int):
+                                   block_size: int, out_shardings=None,
+                                   gather_sharding=None):
     """The prefix-sharing admission executor (docs/serving.md "Prefix
     sharing"): ONE compiled program, two ``lax.cond`` branches, taking the
     admission's **start position** so shared prefix positions are never
@@ -415,12 +432,16 @@ def _build_shared_prefill_executor(model, config: GenerationConfig, chunk: int,
                 cache=cache, length=length, m=m_out,
             )
 
-        return jax.lax.cond(is_final, fin, stage, state)
+        # trace-time: the finalize branch's pool gather stays head-sharded
+        # on the serving mesh — lax.cond traces both branches inside the
+        # context (docs/serving.md "Sharded serving")
+        with paged_ops.gather_constraint(gather_sharding):
+            return jax.lax.cond(is_final, fin, stage, state)
 
-    return jax.jit(run, donate_argnums=_donate(11))
+    return _jit(run, _donate(11), out_shardings)
 
 
-def _build_page_copy_executor(block_size: int):
+def _build_page_copy_executor(block_size: int, out_shardings=None):
     """Copy one pool block's k/v content onto another — the device half of
     copy-on-write (``serving/kv_pool.py``): the host allocator swaps a
     fresh private block into the writing slot's table and this program
@@ -435,12 +456,13 @@ def _build_page_copy_executor(block_size: int):
         pool_v = state["pool_v"].at[idx_dst].set(state["pool_v"][idx_src])
         return {**state, "pool_k": pool_k, "pool_v": pool_v}
 
-    return jax.jit(run, donate_argnums=_donate(0))
+    return _jit(run, _donate(0), out_shardings)
 
 
 def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
                            boundary_mode: str = "cached",
-                           block_size: Optional[int] = None):
+                           block_size: Optional[int] = None,
+                           out_shardings=None, gather_sharding=None):
     """One fixed-shape token step over all slots: sample each row's next
     token from the resident logits, append it, advance every cache by one
     token. ``boundary=True`` additionally runs the boundary-phase step for
@@ -468,7 +490,7 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
         # write ROUTING (``write_ok``): each live pool position is written
         # by exactly the step whose value the dense select would keep, so
         # live rows' logits stay bitwise identical to the dense layout.
-        def run_paged(params, state, table, rng):
+        def _paged_body(params, state, table, rng):
             logits = state["logits"].astype(jnp.float32)
             logits = apply_min_new_tokens(
                 logits, state["steps"][:, None], min_new, config.eos_token_id or 0
@@ -530,7 +552,14 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
             }
             return new_state, token
 
-        return jax.jit(run_paged, donate_argnums=_donate(1))
+        def run_paged(params, state, table, rng):
+            # trace-time: every pool gather in the body (base step AND the
+            # boundary variant) keeps its dense view slot/head-sharded on
+            # the serving mesh (docs/serving.md "Sharded serving")
+            with paged_ops.gather_constraint(gather_sharding):
+                return _paged_body(params, state, table, rng)
+
+        return _jit(run_paged, _donate(1), out_shardings)
 
     def run(params, state, rng):
         logits = state["logits"].astype(jnp.float32)
@@ -599,7 +628,7 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
         }
         return new_state, token
 
-    return jax.jit(run, donate_argnums=_donate(1))
+    return _jit(run, _donate(1), out_shardings)
 
 
 @dataclasses.dataclass
@@ -722,6 +751,21 @@ class SlotServingEngine(ServingEngine):
         are LRU-dropped under pool pressure before an admission is made
         to wait. ``None`` defers to ``PERCEIVER_PREFIX_CACHE`` then the
         measured registry (off when unrecorded).
+    :param mesh: serving parallelism mesh (docs/serving.md "Sharded
+        serving") — a :class:`~perceiver_io_tpu.serving.sharding.
+        ServingMeshSpec` (or resolved ``ServingSharding`` / 4-axis training
+        ``Mesh`` with fsdp/seq at 1). Slots/batch shard along ``data``
+        (``slots`` must divide evenly), attention heads and KV caches —
+        dense per-slot AND the paged pool's flat ``pool_k``/``pool_v`` —
+        along ``model`` (heads must divide evenly); params get the
+        Megatron TP placement. Every executor compiles over the mesh with
+        pinned output shardings; mesh geometry folds into the executor
+        cache keys and the compile ledger's ``mesh`` component, so a mesh
+        change rebuilds and attributes instead of reusing a stale
+        single-device trace. A 1-device mesh reproduces the unsharded
+        engine's behavior exactly, and greedy output on a real mesh stays
+        token-identical (pinned by ``tests/test_sharding.py``). ``None``
+        (default) keeps today's single-device path untouched.
     """
 
     def __init__(self, model, params, config: Optional[GenerationConfig] = None,
@@ -731,7 +775,8 @@ class SlotServingEngine(ServingEngine):
                  kv_layout: Optional[str] = None,
                  kv_block_size: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
-                 prefix_cache: Optional[str] = None, **kwargs):
+                 prefix_cache: Optional[str] = None,
+                 mesh=None, **kwargs):
         super().__init__(
             model, params, config, table, decode_strategy=decode_strategy,
             **kwargs
@@ -753,6 +798,22 @@ class SlotServingEngine(ServingEngine):
         self.prefill_chunk = (
             None if prefill_chunk is None
             else int(min(prefill_chunk, model.max_seq_len))
+        )
+        # -- serving mesh (docs/serving.md "Sharded serving") --------------
+        # slots shard along `data`, heads/KV along `model`; params get the
+        # TP placement once here (self.params stays the caller's unsharded
+        # tree — the autotuners' probe engines compile their own unsharded
+        # executors from it, keyed without the mesh component).
+        self.sharding = as_serving_sharding(mesh)
+        if self.sharding is not None and self.slots % self.sharding.data_size:
+            raise ValueError(
+                f"slots ({self.slots}) must divide evenly over the mesh "
+                f"data axis ({self.sharding.data_size}): the decode "
+                "executor's fixed batch dimension is slot-sharded"
+            )
+        self._exec_params = (
+            self.sharding.put_params(self.params)
+            if self.sharding is not None else self.params
         )
         self.registry.declare_counters(
             "serving_decode_steps_total",
@@ -852,15 +913,25 @@ class SlotServingEngine(ServingEngine):
 
         model, params = self.model, self.params
         self.kv_layout = layout
+        if self.sharding is not None and self.sharding.model_size > 1:
+            _, cache_shapes = _prefill_shapes(model, params)
+            heads = int(cache_shapes["cross_k"].shape[1])
+            if heads % self.sharding.model_size:
+                raise ValueError(
+                    f"attention heads ({heads}) must divide evenly over the "
+                    f"mesh model axis ({self.sharding.model_size}): the KV "
+                    "caches and head projections are head-sharded — shrink "
+                    "the model axis or pad the head count"
+                )
         if layout == "paged":
             self._pool: Optional[KVPagePool] = KVPagePool(
                 self.kv_blocks, self.kv_block_size, self.slots, model.max_seq_len
             )
-            self._state = _blank_state(
+            self._state = self._place_state(_blank_state(
                 model, params, self.slots, self.config.pad_token_id,
                 pool_tokens=self._pool_tokens(),
-            )
-            self._table_dev = jnp.asarray(self._pool.table())
+            ))
+            self._table_dev = self._place_table(self._pool.table())
             # a state rebuild zeroes the device pool, so the prefix index
             # starts (over) empty — stale entries must not describe pages
             # that no longer hold their values. The ACTIVE state re-derives
@@ -876,9 +947,9 @@ class SlotServingEngine(ServingEngine):
             self._pool = None
             self._prefix_index = None
             self.prefix_cache = "off"
-            self._state = _blank_state(
+            self._state = self._place_state(_blank_state(
                 model, params, self.slots, self.config.pad_token_id
-            )
+            ))
             self._table_dev = None
         #: trace-env fingerprint the cached prefix blocks were computed
         #: under — a mid-process flag flip (fused QKV, flash knobs) changes
@@ -907,6 +978,14 @@ class SlotServingEngine(ServingEngine):
             self.registry.set_gauge(
                 "kv_pool_block_bytes", self.kv_block_size * self._kv_token_bytes
             )
+        if self.sharding is not None:
+            # mesh geometry gauges (docs/observability.md): presence of
+            # serving_mesh_devices is how `obs report` knows a mesh ran
+            self.registry.set_gauge(
+                "serving_mesh_devices", self.sharding.num_devices
+            )
+            self.registry.set_gauge("serving_mesh_data", self.sharding.data_size)
+            self.registry.set_gauge("serving_mesh_model", self.sharding.model_size)
         self._update_kv_gauges()
 
     def _update_kv_gauges(self) -> None:
@@ -944,13 +1023,34 @@ class SlotServingEngine(ServingEngine):
                 )
                 base["frees"] = pool.frees_total
         self.registry.set_gauge("kv_cache_resident_bytes", resident)
+        if self.sharding is not None:
+            # the model-axis shard of the live KV bytes (heads are the
+            # sharded dimension of both pool and dense caches); the data
+            # axis divides the DENSE layout's slot rows further, but the
+            # paged pool — the layout per-shard sizing matters for — is
+            # shared across data shards (docs/observability.md)
+            self.registry.set_gauge(
+                "kv_cache_resident_bytes_per_shard",
+                resident // self.sharding.model_size,
+            )
         default_ledger().set_kv_cache_bytes(resident)
+
+    def _place_state(self, state: dict) -> dict:
+        """Place a freshly built slot state onto the serving mesh (identity
+        when unsharded). Every state (re)build routes through here so the
+        executors' committed-input signatures never drift."""
+        return state if self.sharding is None else self.sharding.put_state(state)
+
+    def _place_table(self, table) -> jnp.ndarray:
+        if self.sharding is None:
+            return jnp.asarray(table)
+        return self.sharding.put_leaf("table", np.asarray(table))
 
     def _push_table(self) -> None:
         """Refresh the device copy of the block table after the allocator
         changed it (admit/chunk-progress/decode page crossing/retire). A
         (slots, pages) int32 transfer — tiny next to a decode step."""
-        self._table_dev = jnp.asarray(self._pool.table())
+        self._table_dev = self._place_table(self._pool.table())
 
     def _kv_release(self, slot: int, cause: str = "retire") -> None:
         """Return a retired/failed slot's pages to the pool and refresh
@@ -983,9 +1083,13 @@ class SlotServingEngine(ServingEngine):
             ("paged", self.kv_block_size, self.kv_blocks)
             if self.kv_layout == "paged" else ()
         )
+        # mesh geometry (axis sizes + concrete device ids) specializes every
+        # executor — shardings are baked into the compiled program, so a
+        # mesh flip must rebuild, never reuse the other geometry's trace
+        mesh_fp = () if self.sharding is None else self.sharding.fingerprint()
         return (
             kind, type(self.model).__qualname__, model_fingerprint(self.model),
-            cfg, self.slots, trace_env_fingerprint(), *kv, *extra,
+            cfg, self.slots, trace_env_fingerprint(), *kv, *mesh_fp, *extra,
         )
 
     def _ledger_components(self, **extra) -> dict:
@@ -1009,16 +1113,48 @@ class SlotServingEngine(ServingEngine):
             components["kv_layout"] = (
                 f"paged:{self.kv_blocks}x{self.kv_block_size}"
             )
+        if self.sharding is not None:
+            components["mesh"] = self.sharding.describe()
         return components
 
     def _kv_block_size_arg(self) -> Optional[int]:
         return self.kv_block_size if self.kv_layout == "paged" else None
 
+    # -- sharded-executor helpers (docs/serving.md "Sharded serving"). All
+    # None on the unsharded engine; computed only inside cached_executor's
+    # build thunks, so the per-dispatch hit path stays free of tree maps.
+    def _state_out_shardings(self):
+        if self.sharding is None:
+            return None
+        return self.sharding.state_shardings(self._state)
+
+    def _decode_out_shardings(self):
+        if self.sharding is None:
+            return None
+        return (
+            self.sharding.state_shardings(self._state),
+            self.sharding.tokens_sharding(self.slots),
+        )
+
+    def _chunk_out_shardings(self):
+        if self.sharding is None:
+            return None
+        _, cache_s = _prefill_shapes(self.model, self.params)
+        stage = self.sharding.leaf_sharding("stage_k", cache_s["cross_k"].shape)
+        return (stage, stage, self.sharding.state_shardings(self._state))
+
+    def _gather_sharding(self):
+        """Constraint for the paged attend's transient dense gather."""
+        if self.sharding is None or self.kv_layout != "paged":
+            return None
+        return self.sharding.named(self.sharding.gathered_kv_spec())
+
     def _prefill_executor(self, bucket_len: int):
         return cached_executor(
             _EXECUTOR_CACHE, self._cache_key("slot_prefill", bucket_len),
             lambda: _build_prefill_executor(
-                self.model, self.config, bucket_len, self._kv_block_size_arg()
+                self.model, self.config, bucket_len, self._kv_block_size_arg(),
+                out_shardings=self._state_out_shardings(),
             ),
             ledger_site="slot_prefill",
             ledger_components=lambda: self._ledger_components(
@@ -1033,6 +1169,7 @@ class SlotServingEngine(ServingEngine):
             lambda: _build_chunked_prefill_executor(
                 self.model, self.config, self.prefill_chunk,
                 self._kv_block_size_arg(),
+                out_shardings=self._chunk_out_shardings(),
             ),
             ledger_site="slot_prefill_chunk",
             ledger_components=lambda: self._ledger_components(
@@ -1054,7 +1191,9 @@ class SlotServingEngine(ServingEngine):
             _EXECUTOR_CACHE,
             self._cache_key("slot_prefill_shared", chunk),
             lambda: _build_shared_prefill_executor(
-                self.model, self.config, chunk, self.kv_block_size
+                self.model, self.config, chunk, self.kv_block_size,
+                out_shardings=self._state_out_shardings(),
+                gather_sharding=self._gather_sharding(),
             ),
             ledger_site="slot_prefill_shared",
             ledger_components=lambda: self._ledger_components(chunk=chunk),
@@ -1063,7 +1202,9 @@ class SlotServingEngine(ServingEngine):
     def _page_copy_executor(self):
         return cached_executor(
             _EXECUTOR_CACHE, self._cache_key("kv_page_copy"),
-            lambda: _build_page_copy_executor(self.kv_block_size),
+            lambda: _build_page_copy_executor(
+                self.kv_block_size, out_shardings=self._state_out_shardings()
+            ),
             ledger_site="kv_page_copy",
             ledger_components=lambda: self._ledger_components(),
         )
@@ -1091,6 +1232,8 @@ class SlotServingEngine(ServingEngine):
             lambda: _build_decode_executor(
                 self.model, self.config, boundary, mode,
                 self._kv_block_size_arg(),
+                out_shardings=self._decode_out_shardings(),
+                gather_sharding=self._gather_sharding(),
             ),
             ledger_site="slot_decode",
             ledger_components=lambda: self._ledger_components(
@@ -1427,13 +1570,13 @@ class SlotServingEngine(ServingEngine):
             self._push_table()
             self._update_kv_gauges()
             self._state = executor(
-                self.params, jnp.asarray(ids), jnp.asarray(pad),
+                self._exec_params, jnp.asarray(ids), jnp.asarray(pad),
                 np.int32(slot), jnp.asarray(self._pool.table_row(slot)),
                 self._state,
             )
         else:
             self._state = executor(
-                self.params, jnp.asarray(ids), jnp.asarray(pad),
+                self._exec_params, jnp.asarray(ids), jnp.asarray(pad),
                 np.int32(slot), self._state,
             )
         # fetch one (tiny) output leaf: the executor is a single XLA program,
@@ -1513,6 +1656,12 @@ class SlotServingEngine(ServingEngine):
             _, cache_s = _prefill_shapes(self.model, self.params)
             stage_k = jnp.zeros(cache_s["cross_k"].shape, cache_s["cross_k"].dtype)
             stage_v = jnp.zeros(cache_s["cross_v"].shape, cache_s["cross_v"].dtype)
+            if self.sharding is not None:
+                # committed placement matching the chunk executor's pinned
+                # output shardings — the first chunk call's input signature
+                # must equal every later call's (AOT strictness)
+                stage_k = self.sharding.put_leaf("stage_k", stage_k)
+                stage_v = self.sharding.put_leaf("stage_v", stage_v)
         self._admitting = _ChunkedAdmit(
             req=req, slot=slot, bucket_len=bucket_len, m0=m0,
             window=window, pad=np.asarray([n - L], np.int32),
@@ -1559,7 +1708,7 @@ class SlotServingEngine(ServingEngine):
             # pages; [lo, hi) bounds the writable span so shared pages are
             # never written through
             self._state = self._shared_prefill_executor()(
-                self.params, tokens, np.int32(off), np.bool_(final),
+                self._exec_params, tokens, np.int32(off), np.bool_(final),
                 jnp.asarray(admit.window), jnp.asarray(admit.pad),
                 np.int32(admit.m0), np.int32(admit.slot), table_row,
                 np.int32(admit.lo), np.int32(admit.hi), self._state,
@@ -1570,7 +1719,7 @@ class SlotServingEngine(ServingEngine):
         else:
             executor = self._chunked_prefill_executor()
             admit.stage_k, admit.stage_v, self._state = executor(
-                self.params, tokens, np.int32(off), np.bool_(final),
+                self._exec_params, tokens, np.int32(off), np.bool_(final),
                 jnp.asarray(admit.window), jnp.asarray(admit.pad),
                 np.int32(admit.m0), np.int32(admit.slot), table_row,
                 admit.stage_k, admit.stage_v, self._state,
@@ -1665,10 +1814,10 @@ class SlotServingEngine(ServingEngine):
             pool_tokens = self._pool_tokens()
         else:
             pool_tokens = None
-        self._state = _blank_state(
+        self._state = self._place_state(_blank_state(
             self.model, self.params, self.slots, self.config.pad_token_id,
             pool_tokens=pool_tokens,
-        )
+        ))
         self._update_slot_gauges()
         return failed
 
@@ -1774,6 +1923,11 @@ class SlotServingEngine(ServingEngine):
         state). Returns the previous slot count."""
         if new_slots < 1:
             raise ValueError(f"slots must be >= 1, got {new_slots}")
+        if self.sharding is not None and new_slots % self.sharding.data_size:
+            raise ValueError(
+                f"slots ({new_slots}) must divide evenly over the mesh "
+                f"data axis ({self.sharding.data_size})"
+            )
         if any(s is not None for s in self._slots) or self._admitting is not None:
             raise RuntimeError(
                 "resize_slots() with requests resident in slots would "
@@ -2007,10 +2161,12 @@ class SlotServingEngine(ServingEngine):
             ):
                 if self._pool is not None:
                     self._state, tokens = executor(
-                        self.params, self._state, self._table_dev, key
+                        self._exec_params, self._state, self._table_dev, key
                     )
                 else:
-                    self._state, tokens = executor(self.params, self._state, key)
+                    self._state, tokens = executor(
+                        self._exec_params, self._state, key
+                    )
                 tokens = np.asarray(tokens)  # host sync: the scheduling point
         except Exception as e:
             self.registry.observe(
@@ -2160,17 +2316,22 @@ class SlotServingEngine(ServingEngine):
             pad = jnp.zeros((1,), jnp.int32)
             if paged:
                 self._state = self._prefill_executor(bucket_len)(
-                    self.params, ids, pad, np.int32(0), row0, self._state
+                    self._exec_params, ids, pad, np.int32(0), row0, self._state
                 )
             else:
                 self._state = self._prefill_executor(bucket_len)(
-                    self.params, ids, pad, np.int32(0), self._state
+                    self._exec_params, ids, pad, np.int32(0), self._state
                 )
         if self.prefill_chunk is not None:
             n = self.model.max_seq_len
             _, cache_s = _prefill_shapes(self.model, self.params)
             sk = jnp.zeros(cache_s["cross_k"].shape, cache_s["cross_k"].dtype)
             sv = jnp.zeros(cache_s["cross_v"].shape, cache_s["cross_v"].dtype)
+            if self.sharding is not None:
+                # match the live admission path's committed staging
+                # placement (AOT signature discipline)
+                sk = self.sharding.put_leaf("stage_k", sk)
+                sv = self.sharding.put_leaf("stage_v", sv)
             tokens = jnp.full((1, self.prefill_chunk), cfg.pad_token_id, jnp.int32)
             window = jnp.full((1, n), cfg.pad_token_id, jnp.int32)
             pad = jnp.zeros((1,), jnp.int32)
@@ -2178,7 +2339,7 @@ class SlotServingEngine(ServingEngine):
             executor = self._chunked_prefill_executor()
             for final in (False, True):  # one program: lax.cond traces both
                 sk, sv, self._state = executor(
-                    self.params, tokens, np.int32(0), np.bool_(final),
+                    self._exec_params, tokens, np.int32(0), np.bool_(final),
                     window, pad, m0, np.int32(0), row0, sk, sv, self._state,
                 )
         if self._prefix_index is not None:
@@ -2194,7 +2355,7 @@ class SlotServingEngine(ServingEngine):
             executor = self._shared_prefill_executor()
             for final in (False, True):
                 self._state = executor(
-                    self.params, tokens, np.int32(0), np.bool_(final),
+                    self._exec_params, tokens, np.int32(0), np.bool_(final),
                     window, pad, m0, np.int32(0), row0,
                     np.int32(0), np.int32(0), self._state,
                 )
@@ -2204,23 +2365,26 @@ class SlotServingEngine(ServingEngine):
         for boundary in (False, True):
             self._rng, key = jax.random.split(self._rng)
             if paged:
-                table0 = jnp.zeros((self.slots, pages), jnp.int32)
+                # placed like the live _table_dev (AOT signature discipline)
+                table0 = self._place_table(
+                    np.zeros((self.slots, pages), np.int32)
+                )
                 self._state, _ = self._decode_executor(boundary)(
-                    self.params, self._state, table0, key
+                    self._exec_params, self._state, table0, key
                 )
             else:
                 self._state, _ = self._decode_executor(boundary)(
-                    self.params, self._state, key
+                    self._exec_params, self._state, key
                 )
         if self._prefix_index is not None:
             # the state blank below zeroes the device pool; cached blocks
             # must not survive it
             self._prefix_index.flush(self._pool)
             self._update_kv_gauges()
-        self._state = _blank_state(
+        self._state = self._place_state(_blank_state(
             self.model, self.params, self.slots, cfg.pad_token_id,
             pool_tokens=self._pool_tokens() if paged else None,
-        )
+        ))
         return executor_cache_stats()["misses"] - before
 
     # -- observability -------------------------------------------------------
@@ -2251,6 +2415,13 @@ class SlotServingEngine(ServingEngine):
             "decode_strategy_boundary": self._boundary_mode(),
             "kv_layout": self.kv_layout,
         })
+        if self.sharding is not None:
+            out["mesh"] = {
+                "data": self.sharding.data_size,
+                "model": self.sharding.model_size,
+                "devices": self.sharding.num_devices,
+                "spec": self.sharding.describe(),
+            }
         if self._pool is not None:
             out["kv_pool"] = {
                 **self._pool.stats(),
@@ -2294,4 +2465,7 @@ class SlotServingEngine(ServingEngine):
         out["admitting"] = self._admitting is not None
         out["kv_layout"] = self.kv_layout
         out["prefix_cache"] = self.prefix_cache
+        out["mesh"] = (
+            None if self.sharding is None else self.sharding.describe()
+        )
         return out

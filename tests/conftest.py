@@ -95,6 +95,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "sharded: sharded serving-runtime test (slot engine compiled over a "
+        "data x model device mesh — KV head-sharding, mesh-keyed executor "
+        "identity, 1-device byte parity and multi-device token parity on "
+        "the 8-virtual-device CPU backend this conftest forces via "
+        "XLA_FLAGS; serving/sharding.py, parallel/partition.py, "
+        "docs/serving.md \"Sharded serving\"); CPU-fast, runs in the tier-1 "
+        "suite with a per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "gateway: HTTP/SSE streaming-gateway test (per-token streaming over "
         "real sockets, client-disconnect cancellation, socket-anchored TTFT; "
         "serving/gateway.py, docs/serving.md); CPU-fast, runs in the tier-1 "
