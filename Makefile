@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench prefix-cache prefix-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -155,6 +155,28 @@ paged-bench:
 	model = CausalLanguageModel(cfg); \
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'paged_kv': bench._bench_paged_kv(model, params, cfg)}, indent=2))"
+
+# quantized-KV suite (docs/serving.md "Quantized KV"): int8 pool + scale
+# scatter/gather units, greedy parity vs the exact paged layout, quality-
+# gated autotune/persistence, ragged-kernel interpreter parity — CPU-fast,
+# also tier-1, per-test timeout budget via the conftest SIGALRM guard
+quant-kv:
+	$(PY) -m pytest tests/ -q -m quant_kv --continue-on-collection-errors
+
+# exact-vs-int8 paged-KV A/B at the CPU-fallback shape (docs/serving.md
+# "Quantized KV"): ONE simulated HBM budget, residents-per-HBM-byte
+# ratio, tokens/s, greedy token-match rate, quality-gate verdict
+quant-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'quant_kv': bench._bench_quant_kv(model, params, cfg)}, indent=2))"
 
 # cross-request prefix-sharing suite (docs/serving.md "Prefix sharing"):
 # COW/refcount allocator drills, radix-index units, greedy token-identity

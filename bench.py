@@ -556,6 +556,22 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "paged_kv": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: quantized-KV A/B (exact vs int8 pool at one budget) ----
+        if left() > 150.0:
+            log("run: quant-KV A/B (exact vs int8 paged pool at one budget)")
+            try:
+                qkv = _bench_quant_kv(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "quant_kv": qkv})
+                log(f"run: quant-KV residents {qkv['int8']['max_residents']} "
+                    f"vs exact {qkv['exact']['max_residents']} at the same "
+                    f"budget ({qkv['residents_per_hbm_byte_ratio']}x, "
+                    f"token_match={qkv['token_match_rate']}, quality gate "
+                    f"passed={qkv['quality_gate']['passed']})")
+            except Exception as e:
+                log(f"run: quant-KV A/B failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "quant_kv": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: prefix-cache A/B (Zipf shared prefixes, COW sharing) ----
         if left() > 150.0:
             log("run: prefix-cache A/B (Zipf shared prefixes, unshared vs COW-shared)")
@@ -1237,6 +1253,154 @@ def _bench_paged_kv(model, params, cfg, *, dense_slots: int = 4,
             (useful_tokens / paged_dt) / (useful_tokens / dense_dt), 2
         ),
         "token_identical": token_identical,
+    }
+
+
+def _bench_quant_kv(model, params, cfg, *, exact_slots: int = 4,
+                    n_requests: int = 32, block_size: int = None,
+                    new_tokens: int = 4):
+    """Exact-vs-int8 paged KV A/B at ONE simulated HBM budget (ISSUE 16
+    acceptance; docs/serving.md "Quantized KV"). The exact arm sizes a
+    block pool to ``exact_slots`` context-lengths of KV; the int8 arm gets
+    the SAME byte budget, which buys ``~4d/(d+4)`` times the blocks (int8
+    entries + f32 per-(position, head) scales vs exact entries) and
+    therefore proportionally more concurrent residents on short-request
+    traffic — ``residents_per_hbm_byte_ratio`` is the recorded acceptance
+    number, alongside tokens/s, the greedy token-match rate between the
+    arms, and the autotuner quality probe's logit-delta verdict (the gate
+    that decides whether ``kv_layout="auto"`` may ever pick int8).
+
+    Params stay f32 — the CPU probe's computation dtype — so the byte
+    ratio is the honest f32-pool-vs-int8-pool one (recorded per arm as
+    ``pos_bytes``/``dtype``), not an assumed-bf16 figure."""
+    import numpy as np
+
+    from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    n = cfg.max_seq_len
+    num_latents = min(4, cfg.max_latents)
+    if block_size is None:
+        block_size = max(4, n // 32)
+    pages_per_slot = -(-n // block_size)
+    prompt_len = max(num_latents, min(24, n // 4))
+    rng = np.random.default_rng(0)
+    gen = GenerationConfig(
+        max_new_tokens=new_tokens, num_latents=num_latents,
+        sampling=SamplingConfig(temperature=0.0),  # greedy: comparable arms
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=prompt_len, dtype=np.int32)
+        for _ in range(n_requests)
+    ]
+    useful_tokens = n_requests * new_tokens
+    table = BucketTable(prompt_lens=(prompt_len,), batch_sizes=(1,))
+
+    # per-position byte costs from the ENGINES' own accounting (satellite:
+    # capacity math follows the resolved layout's dtype), read off two
+    # 1-slot throwaway engines rather than re-derived here
+    def pos_bytes(layout):
+        e = SlotServingEngine(
+            model, params, gen, table, slots=1, kv_layout=layout,
+            kv_block_size=block_size,
+        )
+        return e._kv_token_bytes + e._kv_scale_token_bytes, str(
+            e.stats()["kv_pool"]["dtype"]
+        )
+
+    exact_pos_bytes, exact_dtype = pos_bytes("paged")
+    int8_pos_bytes, int8_dtype = pos_bytes("paged_int8")
+    bpr = -(-(prompt_len + new_tokens) // block_size)  # blocks per request
+    # the simulated HBM budget: exactly ``exact_slots`` concurrent
+    # residents' worth of exact-pool blocks — scarce enough that BOTH arms
+    # are block-bound (not request- or slot-capped), so the resident ratio
+    # measures bytes and nothing else
+    budget_blocks = exact_slots * bpr
+    budget_bytes = budget_blocks * block_size * exact_pos_bytes
+    int8_blocks = int(budget_bytes // (block_size * int8_pos_bytes))
+    slots_e = max(1, min(n_requests, budget_blocks // bpr))
+    slots_q = max(1, min(n_requests, int8_blocks // bpr))
+
+    def run(layout, slots, kv_blocks):
+        def make():
+            return SlotServingEngine(
+                model, params, gen, table, slots=slots, kv_layout=layout,
+                kv_block_size=block_size, kv_blocks=kv_blocks,
+            )
+        compile_engine = make()
+        for p in prompts:
+            compile_engine.submit(p)
+        compile_engine.run_until_idle()
+        engine = make()
+        handles = [engine.submit(p) for p in prompts]
+        max_residents = 0
+        t0 = time.perf_counter()
+        while engine.pending():
+            engine.step()
+            active = sum(1 for s in engine._slots if s is not None)
+            if engine._admitting is not None:
+                active += 1
+            max_residents = max(max_residents, active)
+        dt = time.perf_counter() - t0
+        return engine, dt, max_residents, [h.result for h in handles]
+
+    _, exact_dt, exact_res, exact_outs = run("paged", slots_e, budget_blocks)
+    int8_engine, int8_dt, int8_res, int8_outs = run(
+        "paged_int8", slots_q, int8_blocks
+    )
+    ident = total = match = 0
+    for a, b in zip(exact_outs, int8_outs):
+        if a is None or b is None:
+            continue
+        a, b = np.asarray(a), np.asarray(b)
+        ident += int(np.array_equal(a, b))
+        L = min(a.size, b.size)
+        total += max(a.size, b.size)
+        match += int(np.sum(a[:L] == b[:L]))
+    quality = strategy_mod.quant_quality_probe(
+        model, params, block_size=min(block_size, 16)
+    )
+    pool = int8_engine.stats()["kv_pool"]
+    return {
+        "workload": {
+            "requests": n_requests,
+            "useful_tokens": useful_tokens,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "block_size": block_size,
+            "blocks_per_request": bpr,
+            "hbm_budget_bytes": int(budget_bytes),
+        },
+        "exact": {
+            "layout": "paged",
+            "dtype": exact_dtype,
+            "pos_bytes": int(exact_pos_bytes),
+            "slots": slots_e,
+            "kv_blocks": budget_blocks,
+            "max_residents": exact_res,
+            "tokens_per_sec": round(useful_tokens / exact_dt, 1),
+        },
+        "int8": {
+            "layout": "paged_int8",
+            "dtype": int8_dtype,
+            "pos_bytes": int(int8_pos_bytes),
+            "slots": slots_q,
+            "kv_blocks": int8_blocks,
+            "max_residents": int8_res,
+            "tokens_per_sec": round(useful_tokens / int8_dt, 1),
+            "block_scale_bytes": pool["block_scale_bytes"],
+            "blocks_high_water": pool["high_water"],
+        },
+        "block_bytes_ratio": round(exact_pos_bytes / int8_pos_bytes, 2),
+        "residents_per_hbm_byte_ratio": round(int8_res / max(1, exact_res), 2),
+        "int8_vs_exact_tokens_ratio": round(
+            (useful_tokens / int8_dt) / (useful_tokens / exact_dt), 2
+        ),
+        "requests_token_identical": ident,
+        "token_match_rate": round(match / max(1, total), 4),
+        "quality_gate": quality,
     }
 
 

@@ -54,8 +54,13 @@ PHASE_CHOICES = ("cached", "recompute")
 #: property as cached-vs-recompute — the paged gather's bookkeeping
 #: competes with the dense layout's footprint — so it lives in this
 #: module's registry, resolved and autotuned the same way.
-KV_LAYOUTS = ("auto", "dense", "paged")
-KV_LAYOUT_CHOICES = ("dense", "paged")
+KV_LAYOUTS = ("auto", "dense", "paged", "paged_int8")
+KV_LAYOUT_CHOICES = ("dense", "paged", "paged_int8")
+#: the layouts that address KV through the block pool (``paged_int8`` is
+#: ``paged`` plus int8 storage with per-(position, head) dequant scales,
+#: docs/serving.md "Quantized KV") — everywhere the engine asks "is this
+#: the paged machinery" it checks membership here, not ``== "paged"``
+PAGED_KV_LAYOUTS = ("paged", "paged_int8")
 
 #: slot-engine cross-request prefix-cache axis (docs/serving.md "Prefix
 #: sharing"): whether paged admissions map hot prompt-prefix blocks by
@@ -73,6 +78,27 @@ ENV_KV_LAYOUT = "PERCEIVER_KV_LAYOUT"
 ENV_PREFIX_CACHE = "PERCEIVER_PREFIX_CACHE"
 #: env var pointing at a persisted strategy-registry JSON file
 ENV_FILE = "PERCEIVER_DECODE_STRATEGY_FILE"
+#: env var overriding the int8 quality-gate budget (max greedy logit
+#: delta vs the exact paged layout the autotuner will accept)
+ENV_KV_QUANT_BUDGET = "PERCEIVER_KV_QUANT_BUDGET"
+#: default quality-gate budget: max |logit delta| across every greedy
+#: decode step of the probe workload. 0.05 is far below typical
+#: top-1/top-2 logit gaps at the probe shapes yet generous to 8-bit
+#: rounding noise; deployments tune it like any other strategy knob.
+DEFAULT_KV_QUANT_BUDGET = 0.05
+
+
+def kv_quant_budget() -> float:
+    """The int8 quality-gate budget (:data:`ENV_KV_QUANT_BUDGET` >
+    :data:`DEFAULT_KV_QUANT_BUDGET`; unparseable overrides fall back to
+    the default, the registry-env-knob discipline)."""
+    raw = os.environ.get(ENV_KV_QUANT_BUDGET)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_KV_QUANT_BUDGET
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,6 +199,16 @@ def lookup_kv_layout(model, platform: Optional[str] = None) -> Optional[str]:
     _maybe_load_env_file()
     entry = _KV_REGISTRY.get(registry_key(model, platform))
     return None if entry is None else entry["kv_layout"]
+
+
+def kv_entry(model, platform: Optional[str] = None) -> Optional[dict]:
+    """The full KV-layout registry entry (verdict + measurement metadata,
+    including the ``quant_gate`` dict the autotuner records), or None.
+    Read-only view for observability (the engine's warmup reports the
+    quality-gate outcome through ``kv_quant_fallback_total``)."""
+    _maybe_load_env_file()
+    entry = _KV_REGISTRY.get(registry_key(model, platform))
+    return None if entry is None else dict(entry)
 
 
 def record_kv_layout(model, kv_layout: str, *, platform: Optional[str] = None,
@@ -447,12 +483,15 @@ def resolve_kv_layout(
     *,
     platform: Optional[str] = None,
 ) -> str:
-    """Resolve a slot-engine KV-layout request into ``"dense"`` or
-    ``"paged"``.
+    """Resolve a slot-engine KV-layout request into one of
+    :data:`KV_LAYOUT_CHOICES` (``"dense"``, ``"paged"``, ``"paged_int8"``).
 
     Order mirrors :func:`resolve`: explicit mode > :data:`ENV_KV_LAYOUT` >
     ``"auto"`` (registry lookup, falling back to ``dense`` — the
-    status-quo layout — when nothing has been measured).
+    status-quo layout — when nothing has been measured). ``paged_int8``
+    only wins a lookup when the autotuner's quality gate passed at record
+    time (:func:`autotune_kv_layout`); an explicit request is taken at
+    face value — the operator owns the quality tradeoff then.
     """
     if mode is None:
         mode = os.environ.get(ENV_KV_LAYOUT) or "auto"
@@ -466,6 +505,101 @@ def resolve_kv_layout(
     return mode
 
 
+def _kv_probe_workload(model, slots: int, new_tokens: int):
+    """The shared KV-probe geometry (autotune + quality gate): mid-context
+    prompts — the paged gather's cost scales with the context, so probing
+    at a trivial length would flatter the paged arm — and an EOS-free
+    greedy config, so retirement is purely by count and every arm runs
+    the identical schedule regardless of token divergence."""
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.serving import BucketTable
+
+    n = model.max_seq_len
+    num_latents = min(2, model.max_latents)
+    prompt_len = max(num_latents, min(n // 2, model.max_prefix_len + num_latents))
+    new_tokens = max(1, min(new_tokens, n - prompt_len))
+    table = BucketTable(prompt_lens=(prompt_len,), batch_sizes=(1,))
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, model.config.vocab_size, size=prompt_len, dtype=np.int32)
+        for _ in range(slots)
+    ]
+    return table, gcfg, prompts, new_tokens
+
+
+def quant_quality_probe(
+    model,
+    params,
+    *,
+    slots: int = 2,
+    block_size: int = 16,
+    new_tokens: int = 8,
+    budget: Optional[float] = None,
+) -> dict:
+    """Measure the int8 layout's greedy fidelity against the exact paged
+    layout at the bound shape — the *quality gate* the autotuner applies
+    before it will select ``paged_int8``.
+
+    Drives one exact-paged and one int8-paged engine in LOCKSTEP over the
+    shared probe workload (EOS-free, so both schedules are identical by
+    construction) and after every step compares the per-slot logits of
+    slots active in BOTH engines (idle-slot logits are garbage and
+    excluded). Returns::
+
+        {"max_logit_delta": float,   # worst |exact - int8| logit, any step
+         "token_match_rate": float,  # greedy tokens identical across arms
+         "budget": float,            # the gate threshold applied
+         "passed": bool}             # max_logit_delta <= budget
+
+    The verdict rides in the registry entry (``quant_gate``) so serving
+    warmup can report a failed gate through ``kv_quant_fallback_total``.
+    """
+    import numpy as np
+
+    from perceiver_io_tpu.serving.slots import SlotServingEngine
+
+    budget = kv_quant_budget() if budget is None else float(budget)
+    table, gcfg, prompts, _ = _kv_probe_workload(model, slots, new_tokens)
+
+    engines, reqs = {}, {}
+    for layout in PAGED_KV_LAYOUTS:
+        eng = SlotServingEngine(
+            model, params, gcfg, table, slots=slots, kv_layout=layout,
+            kv_block_size=block_size,
+        )
+        engines[layout] = eng
+        reqs[layout] = [eng.submit(p) for p in prompts]
+    exact, quant = engines["paged"], engines["paged_int8"]
+    max_delta = 0.0
+    while exact.pending() or quant.pending():
+        if exact.pending():
+            exact.step()
+        if quant.pending():
+            quant.step()
+        live = [
+            i for i, (se, sq) in enumerate(zip(exact._slots, quant._slots))
+            if se is not None and sq is not None
+        ]
+        if live:
+            le = np.asarray(exact._state["logits"])[live]
+            lq = np.asarray(quant._state["logits"])[live]
+            max_delta = max(max_delta, float(np.max(np.abs(le - lq))))
+    matched = total = 0
+    for r_exact, r_quant in zip(reqs["paged"], reqs["paged_int8"]):
+        te, tq = list(r_exact.result), list(r_quant.result)
+        total += max(len(te), len(tq))
+        matched += sum(1 for a, b in zip(te, tq) if a == b)
+    return {
+        "max_logit_delta": round(max_delta, 6),
+        "token_match_rate": round(matched / max(total, 1), 4),
+        "budget": budget,
+        "passed": bool(max_delta <= budget),
+    }
+
+
 def autotune_kv_layout(
     model,
     params,
@@ -477,19 +611,26 @@ def autotune_kv_layout(
     persist: Optional[str] = None,
     force: bool = False,
 ) -> str:
-    """Measure dense vs block-paged slot decoding at the bound shape and
-    memoize the winner; returns ``"dense"`` or ``"paged"``.
+    """Measure dense vs block-paged vs int8-paged slot decoding at the
+    bound shape and memoize the winner; returns one of
+    :data:`KV_LAYOUT_CHOICES`.
 
     The probe drives a tiny :class:`~perceiver_io_tpu.serving.slots.
     SlotServingEngine` per layout (same prompts, same schedule, greedy):
     one pass to compile, one timed pass, per-token ms on ``clock``. Ties —
     including the all-zero durations an un-advanced FakeClock produces —
-    break toward ``dense`` (the status-quo layout), deterministically.
+    break toward ``dense`` (the status-quo layout), deterministically,
+    and toward exact ``paged`` over ``paged_int8``. The int8 arm is
+    additionally **quality-gated**: :func:`quant_quality_probe` must
+    measure a greedy logit delta within :func:`kv_quant_budget`, else the
+    autotuner falls back to exact ``paged`` no matter the timing (the
+    gate verdict is recorded either way, as ``quant_gate``).
     Note the tradeoff being measured is TIME at equal capacity; the paged
-    layout's admission win (more residents per HBM byte) is a capacity
-    property the ``extras.paged_kv`` bench measures separately — an
-    operator who sizes ``kv_blocks`` below dense capacity has already
-    chosen paged and should pass it explicitly.
+    layouts' admission win (more residents per HBM byte — ~4x more again
+    for int8) is a capacity property the ``extras.paged_kv`` /
+    ``extras.quant_kv`` benches measure separately — an operator who
+    sizes ``kv_blocks`` below dense capacity has already chosen paged and
+    should pass it explicitly.
 
     :param persist: JSON path — merged before deciding (a persisted verdict
         short-circuits the measurement unless ``force``) and rewritten
@@ -498,8 +639,6 @@ def autotune_kv_layout(
     import jax
     import numpy as np
 
-    from perceiver_io_tpu.inference.generate import GenerationConfig
-    from perceiver_io_tpu.serving import BucketTable
     from perceiver_io_tpu.serving.slots import SlotServingEngine
 
     if persist:
@@ -509,26 +648,14 @@ def autotune_kv_layout(
     if not force and key in _KV_REGISTRY:
         return _KV_REGISTRY[key]["kv_layout"]
 
-    n = model.max_seq_len
-    num_latents = min(2, model.max_latents)
-    # mid-context prompt: the paged gather's cost scales with the context,
-    # so probing at a trivial length would flatter the paged arm
-    prompt_len = max(num_latents, min(n // 2, model.max_prefix_len + num_latents))
-    new_tokens = max(1, min(new_tokens, n - prompt_len))
-    table = BucketTable(prompt_lens=(prompt_len,), batch_sizes=(1,))
-    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=num_latents)
-    rng = np.random.default_rng(0)
-    prompts = [
-        rng.integers(1, model.config.vocab_size, size=prompt_len, dtype=np.int32)
-        for _ in range(slots)
-    ]
+    table, gcfg, prompts, new_tokens = _kv_probe_workload(model, slots, new_tokens)
 
     timings = {}
     for layout in KV_LAYOUT_CHOICES:
-        # explicit pool sizing implies the paged layout (the engine
-        # rejects sizing a dense pool), so only that arm gets block_size
+        # explicit pool sizing implies a paged layout (the engine rejects
+        # sizing a dense pool), so only those arms get block_size
         kv_kwargs = (
-            {"kv_block_size": block_size} if layout == "paged" else {}
+            {"kv_block_size": block_size} if layout in PAGED_KV_LAYOUTS else {}
         )
 
         def make():
@@ -545,11 +672,23 @@ def autotune_kv_layout(
         t0 = clock()
         engine.run_until_idle()
         timings[layout] = (clock() - t0) / (slots * new_tokens) * 1e3
+    quality = quant_quality_probe(
+        model, params, slots=slots, block_size=block_size,
+        new_tokens=new_tokens,
+    )
     winner = "dense" if timings["dense"] <= timings["paged"] else "paged"
+    if (
+        winner == "paged"
+        and quality["passed"]
+        and timings["paged_int8"] < timings["paged"]
+    ):
+        winner = "paged_int8"
     record_kv_layout(
         model, winner,
         dense_ms_per_token=round(timings["dense"], 4),
         paged_ms_per_token=round(timings["paged"], 4),
+        paged_int8_ms_per_token=round(timings["paged_int8"], 4),
+        quant_gate=quality,
         slots=slots, block_size=block_size, new_tokens=new_tokens,
     )
     if persist:

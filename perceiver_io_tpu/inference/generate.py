@@ -342,6 +342,7 @@ def _prefill_finalize(mdl, window: jnp.ndarray, pad_count: jnp.ndarray,
 def _prefill_finalize_paged(
     mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.ndarray,
     pool_k, pool_v, table_row: jnp.ndarray, block_size: int,
+    scale_k=None, scale_v=None,
 ):
     """:func:`_prefill_finalize` over the block-paged KV layout with a
     **suffix-only** contract (docs/serving.md "Prefix sharing"): cross k/v
@@ -354,6 +355,11 @@ def _prefill_finalize_paged(
     back from the pool, and runs the attend + self-attention stack exactly
     as the dense finalize does. A fully-hot prefix stages zero chunks and
     the admission collapses to block-table writes plus this one call.
+
+    ``scale_k``/``scale_v`` (both or neither) carry the int8 layout's
+    per-(position, head) dequant scales: appends quantize through
+    :func:`~perceiver_io_tpu.ops.paged_attention.scatter_kv` and the
+    updated scales join the return tuple right after the pools.
 
     Latent scatter routing: non-real segment slots (prompt shorter than
     the latent budget) route to the null block — the paged analogue of the
@@ -389,23 +395,23 @@ def _prefill_finalize_paged(
     idx = jnp.clip(p_seg, 0, n - 1)
     flat_lat = paged.flat_write_indices(table, idx, block_size)
     flat_lat = jnp.where(is_real, flat_lat, idx % block_size)  # null-route
-    pool_k = pool_k.at[flat_lat[0]].set(
-        k_lat[0].transpose(1, 0, 2).astype(pool_k.dtype)
+    pool_k, scale_k = paged.scatter_kv(
+        pool_k, scale_k, flat_lat[0], k_lat[0].transpose(1, 0, 2)
     )
-    pool_v = pool_v.at[flat_lat[0]].set(
-        v_lat[0].transpose(1, 0, 2).astype(pool_v.dtype)
+    pool_v, scale_v = paged.scatter_kv(
+        pool_v, scale_v, flat_lat[0], v_lat[0].transpose(1, 0, 2)
     )
 
-    # Gather into window-slot alignment and attend exactly as the dense
-    # finalize does (pad slots gather position-0 values the pad mask
-    # zeroes out of the softmax — the _decode_step_boundary argument).
-    slot_abs = jnp.maximum(jnp.arange(n)[None, :] - pad_count[:, None], 0)
-    flat_g = paged.flat_write_indices(table, slot_abs, block_size)
-    k_slots = paged.gather_kv(pool_k, flat_g)
-    v_slots = paged.gather_kv(pool_v, flat_g)
-    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    # Window-aligned attend exactly as the dense finalize's (gather path:
+    # pad slots re-read position 0 and the pad mask zeroes them out of
+    # the softmax — the _decode_step_boundary argument; kernel path: the
+    # ragged kernel over the live span [0, n - pad_count)).
     q = mha.project_q(x_q_lat, RotaryEmbedding(frq_lat, right_align=True))
-    attn = mha.attend(q, k_slots, v_slots, pad_mask=pad_mask, deterministic=True)
+    attn = paged.paged_window_attention(
+        mha.attend, q, pool_k, pool_v, table,
+        block_size=block_size, n=n, pad_count=pad_count,
+        scale_k=scale_k, scale_v=scale_v, project_out=mha.project_out,
+    )
     x = attn + emb_lat
     x = layer.mlp(x) + x
 
@@ -425,6 +431,8 @@ def _prefill_finalize_paged(
     logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
     length = (n - pad_count).astype(jnp.int32)
     cache = {"stack_k": stack_k, "stack_v": stack_v}
+    if scale_k is not None:
+        return logits, pool_k, pool_v, scale_k, scale_v, cache, length, m
     return logits, pool_k, pool_v, cache, length, m
 
 
@@ -557,6 +565,7 @@ def _slot_decode_step_paged(
     mdl, token: jnp.ndarray, pool_k, pool_v, block_table: jnp.ndarray,
     stack_cache: dict, length: jnp.ndarray, m: jnp.ndarray,
     block_size: int, write_ok: Optional[jnp.ndarray] = None,
+    scale_k=None, scale_v=None,
 ):
     """:func:`_slot_decode_step` over the block-paged KV layout
     (``serving/kv_pool.py``): the per-slot dense ``cross_k/cross_v`` rows
@@ -566,7 +575,7 @@ def _slot_decode_step_paged(
     the attend runs through
     :func:`~perceiver_io_tpu.ops.paged_attention.paged_decode_attention` —
     a gather back to the dense view (bitwise-identical masked attend) or
-    the Pallas TPU kernel when enabled. The latent-stack cache stays dense:
+    the ragged Pallas kernel when ``PERCEIVER_RAGGED_KERNEL=1``. The latent-stack cache stays dense:
     it is bounded by ``max_latents`` (a model constant), not the context
     length, so it is outside the ``slots × max_context`` scaling the pool
     exists to break (docs/serving.md).
@@ -577,8 +586,12 @@ def _slot_decode_step_paged(
     routing*: each live pool position is written by exactly the step the
     dense layout's ``where`` select would have kept.
 
-    :return: (next-token logits, pool_k, pool_v, stack cache, length + 1,
-        m + 1).
+    ``scale_k``/``scale_v`` (both or neither) carry the int8 layout's
+    dequant scales; appends quantize via ``scatter_kv`` and the updated
+    scales join the return tuple right after the pools.
+
+    :return: (next-token logits, pool_k, pool_v, [scale_k, scale_v,]
+        stack cache, length + 1, m + 1).
     """
     from perceiver_io_tpu.ops import paged_attention as paged
 
@@ -603,13 +616,14 @@ def _slot_decode_step_paged(
         # boundary rows' appends are owned by the boundary step; route this
         # one to the null block (flat index < block_size is always trash)
         flat_w = jnp.where(write_ok, flat_w, flat_w % block_size)
-    pool_k = pool_k.at[flat_w].set(k_new[:, :, 0].astype(pool_k.dtype))
-    pool_v = pool_v.at[flat_w].set(v_new[:, :, 0].astype(pool_v.dtype))
+    pool_k, scale_k = paged.scatter_kv(pool_k, scale_k, flat_w, k_new[:, :, 0])
+    pool_v, scale_v = paged.scatter_kv(pool_v, scale_v, flat_w, v_new[:, :, 0])
     future = jnp.arange(n)[None, :] > length[:, None]  # True = not yet written
     attn = paged.paged_decode_attention(
         mha.attend, q, pool_k, pool_v, block_table,
         block_size=block_size, n=n, pad_mask=future,
         lengths=jnp.minimum(length + 1, n),
+        scale_k=scale_k, scale_v=scale_v, project_out=mha.project_out,
     )
     x = attn + emb
     x = layer.mlp(x) + x
@@ -637,6 +651,8 @@ def _slot_decode_step_paged(
         x_last = mdl.out_norm(x_last)
     logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
     stack = {"stack_k": stack_k, "stack_v": stack_v}
+    if scale_k is not None:
+        return logits, pool_k, pool_v, scale_k, scale_v, stack, length + 1, m + 1
     return logits, pool_k, pool_v, stack, length + 1, m + 1
 
 
@@ -644,6 +660,7 @@ def _decode_step_boundary_paged(
     mdl, window: jnp.ndarray, pad_count: jnp.ndarray, pool_k, pool_v,
     block_table: jnp.ndarray, length: jnp.ndarray, block_size: int,
     write_ok: Optional[jnp.ndarray] = None,
+    scale_k=None, scale_v=None,
 ):
     """:func:`_decode_step_boundary` over the block-paged KV layout: the
     migration + append writes become table-translated pool scatters and the
@@ -658,7 +675,11 @@ def _decode_step_boundary_paged(
     reproduce the dense executor's per-row ``where`` select at every live
     pool position).
 
-    :return: (next-token logits, pool_k, pool_v, length + 1).
+    ``scale_k``/``scale_v`` follow the same int8-layout contract as
+    :func:`_slot_decode_step_paged`.
+
+    :return: (next-token logits, pool_k, pool_v, [scale_k, scale_v,]
+        length + 1).
     """
     from perceiver_io_tpu.ops import paged_attention as paged
 
@@ -694,16 +715,15 @@ def _decode_step_boundary_paged(
     flat_wi = paged.flat_write_indices(block_table, write_idx, block_size)
     if write_ok is not None:
         flat_wi = jnp.where(write_ok[:, None], flat_wi, flat_wi % block_size)
-    pool_k = pool_k.at[flat_wi].set(k_upd.astype(pool_k.dtype))
-    pool_v = pool_v.at[flat_wi].set(v_upd.astype(pool_v.dtype))
+    pool_k, scale_k = paged.scatter_kv(pool_k, scale_k, flat_wi, k_upd)
+    pool_v, scale_v = paged.scatter_kv(pool_v, scale_v, flat_wi, v_upd)
 
-    slot_abs = jnp.maximum(jnp.arange(n)[None, :] - pad_count[:, None], 0)
-    flat_g = paged.flat_write_indices(block_table, slot_abs, block_size)
-    k_slots = paged.gather_kv(pool_k, flat_g)
-    v_slots = paged.gather_kv(pool_v, flat_g)
-    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
     q = mha.project_q(x_q_lat, RotaryEmbedding(frq_lat, right_align=True))
-    attn = mha.attend(q, k_slots, v_slots, pad_mask=pad_mask, deterministic=True)
+    attn = paged.paged_window_attention(
+        mha.attend, q, pool_k, pool_v, block_table,
+        block_size=block_size, n=n, pad_count=pad_count,
+        scale_k=scale_k, scale_v=scale_v, project_out=mha.project_out,
+    )
     x = attn + emb_lat
     x = layer.mlp(x) + x
 
@@ -716,6 +736,8 @@ def _decode_step_boundary_paged(
     if mdl.config.output_norm:
         x_last = mdl.out_norm(x_last)
     logits = mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+    if scale_k is not None:
+        return logits, pool_k, pool_v, scale_k, scale_v, length + 1
     return logits, pool_k, pool_v, length + 1
 
 

@@ -97,12 +97,12 @@ def trace_env_fingerprint() -> tuple:
         # mirror flash_attention._candidates' validation (LANES == 128):
         # overrides it would ignore must fingerprint like the unset default
         blocks = ()
-    # PERCEIVER_PAGED_KERNEL switches the slot engine's paged decode
-    # attend between the gather reference and the Pallas TPU kernel at
-    # trace time (ops/paged_attention.py) — same mid-process-toggle
-    # contract as the flash knobs
-    paged_kernel = os.environ.get("PERCEIVER_PAGED_KERNEL", "0") == "1"
-    return (fused_qkv_enabled(), min_kv, blocks, paged_kernel)
+    # PERCEIVER_RAGGED_KERNEL switches the slot engine's paged attends
+    # between the gather reference and the ragged Pallas kernel at trace
+    # time (ops/ragged_attention.py; interpreted off-TPU) — same
+    # mid-process-toggle contract as the flash knobs
+    ragged_kernel = os.environ.get("PERCEIVER_RAGGED_KERNEL", "0") == "1"
+    return (fused_qkv_enabled(), min_kv, blocks, ragged_kernel)
 
 
 def _remat_policy(offload: bool):
@@ -231,6 +231,13 @@ class MultiHeadAttention(nn.Module):
             )
         return out
 
+    def project_out(self, o: jnp.ndarray) -> jnp.ndarray:
+        """(b, h, n, cv) raw attention -> merged + output-projected
+        (b, n, out). Exposed for attention implementations that bypass
+        :meth:`attend` (the ragged paged kernel returns raw per-head
+        attention; this is the projection ``attend`` would have applied)."""
+        return self.o_proj(self._merge_heads(o))
+
     def attend(
         self,
         q: jnp.ndarray,
@@ -254,7 +261,7 @@ class MultiHeadAttention(nn.Module):
             max_heads_parallel=self.max_heads_parallel,
             impl=self.attention_impl,
         )
-        return self.o_proj(self._merge_heads(o))
+        return self.project_out(o)
 
     def __call__(
         self,

@@ -78,7 +78,7 @@ HELP_TEXT = {
     "compile_ledger_fallback_total": "Executors demoted from AOT ledger dispatch to plain jit.",
     "hbm_bytes_in_use": "Live device memory from memory_stats() (absent on CPU).",
     "kv_cache_resident_bytes": "Live slot-KV bytes: allocated pages + latent-stack caches under the paged layout; equals capacity when dense.",
-    "kv_cache_capacity_bytes": "Worst-case slot-KV bytes: dense per-slot caches at full context + latent-stack caches.",
+    "kv_cache_capacity_bytes": "Worst-case slot-KV bytes from the resolved layout's dtype: pool blocks (+ int8 dequant scales) when paged, dense per-slot caches at full context otherwise, + latent-stack caches.",
     "kv_cache_resident_bytes_per_shard": "Model-axis shard of the live KV bytes on a sharded serving mesh (docs/serving.md \"Sharded serving\").",
     "serving_mesh_devices": "Devices claimed by the engine's serving mesh (data x model); absent when serving unsharded.",
     "serving_mesh_data": "Serving-mesh data-axis size (slot/batch parallelism).",
@@ -87,7 +87,11 @@ HELP_TEXT = {
     "kv_pool_blocks_in_use": "Pool blocks currently mapped to live token positions.",
     "kv_pool_blocks_reserved": "Pool blocks reserved by resident requests' worst cases (mapped or not).",
     "kv_pool_blocks_high_water": "Peak pool blocks in use over the engine lifetime.",
-    "kv_pool_block_bytes": "Bytes per pool block (block_size positions x per-position k+v).",
+    "kv_pool_block_bytes": "Bytes per pool block (block_size positions x per-position k+v at the resolved layout's dtype; scale bytes excluded).",
+    "kv_pool_block_scale_bytes": "Per-block dequant-scale bytes under kv_layout='paged_int8' (f32 per position/head/tensor); 0 for exact layouts.",
+    "kv_quant_fallback_total": "Autotune runs whose int8 quality gate failed, degrading the verdict to an exact layout (docs/serving.md \"Quantized KV\").",
+    "kv_ragged_kernel_steps_total": "Decode steps served by the ragged paged-attention kernel (PERCEIVER_RAGGED_KERNEL=1) instead of the gather-to-dense reference.",
+    "kv_ragged_kernel_enabled": "1 when a paged engine dispatches the ragged paged-attention kernel, 0 when on the gather reference.",
     "kv_pool_block_allocs_total": "Pool block map operations (admit, chunk progress, decode page crossings).",
     "kv_pool_block_frees_total": "Pool blocks returned on retire/failure.",
     "kv_pool_admit_waits_total": "Requests that waited at the queue head for pool blocks to free.",

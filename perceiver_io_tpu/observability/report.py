@@ -485,6 +485,14 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
         "admit_waits": c("kv_pool_admit_waits_total"),
         "resident_bytes": g("kv_cache_resident_bytes"),
         "capacity_bytes": g("kv_cache_capacity_bytes"),
+        # quantized-KV / ragged-kernel rollup (docs/serving.md "Quantized
+        # KV"): nonzero block_scale_bytes is how a report reader tells an
+        # int8 pool from an exact one without the engine's stats dict
+        "block_bytes": g("kv_pool_block_bytes"),
+        "block_scale_bytes": g("kv_pool_block_scale_bytes"),
+        "quant_fallbacks": c("kv_quant_fallback_total"),
+        "ragged_kernel_enabled": g("kv_ragged_kernel_enabled"),
+        "ragged_kernel_steps": c("kv_ragged_kernel_steps_total"),
         "prefix_cache": prefix,
     }
 
@@ -893,6 +901,22 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"resident {kv['resident_bytes']:,} B of worst-case "
                 f"{kv['capacity_bytes']:,} B "
                 f"({kv['resident_bytes'] / kv['capacity_bytes']:.1%})"
+            )
+        if kv.get("block_bytes") is not None:
+            scale = kv.get("block_scale_bytes") or 0
+            layout = "paged_int8" if scale else "paged (exact)"
+            out.append(
+                f"layout: {layout}  block_bytes={kv['block_bytes']:,}"
+                + (f" + {scale:,} scale" if scale else "")
+                + (
+                    f"  quant_fallbacks={kv['quant_fallbacks']}"
+                    if kv.get("quant_fallbacks") else ""
+                )
+            )
+        if kv.get("ragged_kernel_enabled"):
+            out.append(
+                "ragged kernel: on  steps="
+                f"{kv.get('ragged_kernel_steps') or 0}"
             )
         pc = kv.get("prefix_cache")
         if pc:

@@ -147,6 +147,10 @@ def shard_params(params, mesh: Mesh):
 SERVING_STATE_RULES: Tuple[Tuple[str, Tuple], ...] = (
     # (pool_tokens, heads, head_dim): shared across slots, heads sharded
     (r"^(pool_k|pool_v)$", (None, AXIS_MODEL, None)),
+    # (pool_tokens, heads, 1) int8-layout dequant scales: they address by
+    # the same (position, head) coordinates as the pool, so they shard
+    # WITH their blocks along model (the trailing size-1 dim replicates)
+    (r"^(scale_k|scale_v)$", (None, AXIS_MODEL, None)),
     # (1, heads, n, head_dim) batch-1 staging caches (chunked prefill)
     (r"^(stage_k|stage_v)$", (None, AXIS_MODEL, None, None)),
     # (slots, heads, n, head_dim) dense per-slot caches

@@ -265,9 +265,13 @@ class ServeArgs:
     #: slot-engine cross-KV layout (docs/serving.md "Block-paged KV"):
     #: ``dense`` = per-slot worst-case caches; ``paged`` = shared block
     #: pool + per-slot block tables (more residents per HBM byte under
-    #: long-tail traffic; greedy output identical); ``auto`` measures at
-    #: warmup and memoizes the winner (beaten by an explicit layout, defers
-    #: to PERCEIVER_KV_LAYOUT)
+    #: long-tail traffic; greedy output identical); ``paged_int8`` = the
+    #: paged pool quantized to int8 with per-(position, head) f32 dequant
+    #: scales (docs/serving.md "Quantized KV" — ~3-4x residents per HBM
+    #: byte; approximate: bounded greedy logit drift, gated by the
+    #: autotuner's quality probe); ``auto`` measures at warmup and
+    #: memoizes the winner (beaten by an explicit layout, defers to
+    #: PERCEIVER_KV_LAYOUT)
     kv_layout: str = "auto"
     #: token positions per KV pool block (paged layout; default
     #: min(16, context))
@@ -276,7 +280,7 @@ class ServeArgs:
     #: capacity (slots x pages-per-slot); set it LOWER to serve the same
     #: slot count in less HBM — requests that can't currently fit wait at
     #: the queue head, ones that never could reject at submit. Sizing the
-    #: pool requires --serve.kv_layout=paged (a dense resolution would
+    #: pool requires a paged --serve.kv_layout (a dense resolution would
     #: silently discard the budget, so the engine rejects the combination)
     kv_blocks: Optional[int] = None
     #: cross-request prefix sharing for the paged slot engine

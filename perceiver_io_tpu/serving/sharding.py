@@ -342,7 +342,7 @@ def _probe_main(argv: Optional[list] = None) -> int:
     parser.add_argument("--requests", type=int, default=8)
     parser.add_argument("--new-tokens", type=int, default=8)
     parser.add_argument("--kv-layout", default="dense",
-                        choices=("dense", "paged"))
+                        choices=("dense", "paged", "paged_int8"))
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 

@@ -187,7 +187,8 @@ def _prefill_shapes(model, params):
 
 
 def _blank_state(model, params, slots: int, pad_token_id: int,
-                 pool_tokens: Optional[int] = None) -> dict:
+                 pool_tokens: Optional[int] = None,
+                 quantized: bool = False) -> dict:
     """Zero-initialized persistent multi-slot decode state; KV-cache and
     logits shapes/dtypes track the model's computation dtype.
 
@@ -196,9 +197,14 @@ def _blank_state(model, params, slots: int, pad_token_id: int,
     sized at the full context, the state holds ONE flat token-major pool
     ``pool_k/pool_v`` of that many positions, addressed through the
     engine's :class:`~perceiver_io_tpu.serving.kv_pool.KVPagePool` block
-    tables. The latent-stack caches stay dense either way — they scale
-    with ``max_latents`` (a model constant), not ``max_context``, so they
-    are not part of the ``slots × max_context`` term the pool breaks."""
+    tables. ``quantized`` (the ``paged_int8`` layout) stores the pool
+    int8 and adds per-(position, head) f32 dequant scales ``scale_k/
+    scale_v`` addressed by the same flat indices; a zero scale (every
+    never-written position) dequantizes to exactly 0.0, so the blank
+    pool reads as harmlessly as the exact layout's zeros. The
+    latent-stack caches stay dense either way — they scale with
+    ``max_latents`` (a model constant), not ``max_context``, so they are
+    not part of the ``slots × max_context`` term the pool breaks."""
     n = model.max_seq_len
     logits_s, cache_s = _prefill_shapes(model, params)
 
@@ -220,8 +226,12 @@ def _blank_state(model, params, slots: int, pad_token_id: int,
         state["cross_v"] = z(cache_s["cross_v"])
     else:
         _, h, _, d = cache_s["cross_k"].shape
-        state["pool_k"] = jnp.zeros((pool_tokens, h, d), cache_s["cross_k"].dtype)
-        state["pool_v"] = jnp.zeros((pool_tokens, h, d), cache_s["cross_v"].dtype)
+        pool_dtype = jnp.int8 if quantized else cache_s["cross_k"].dtype
+        state["pool_k"] = jnp.zeros((pool_tokens, h, d), pool_dtype)
+        state["pool_v"] = jnp.zeros((pool_tokens, h, d), pool_dtype)
+        if quantized:
+            state["scale_k"] = jnp.zeros((pool_tokens, h, 1), jnp.float32)
+            state["scale_v"] = jnp.zeros((pool_tokens, h, 1), jnp.float32)
     return state
 
 
@@ -254,12 +264,17 @@ def _insert_row(state: dict, slot, *, window, pad, logits, cache, length, m,
     else:
         n = cache["cross_k"].shape[2]
         flat = paged_ops.flat_position_indices(table_row, block_size, n)
-        new["pool_k"] = state["pool_k"].at[flat].set(
-            cache["cross_k"][0].transpose(1, 0, 2).astype(state["pool_k"].dtype)
+        # scatter_kv quantizes when the state carries scales (paged_int8)
+        new["pool_k"], scale_k = paged_ops.scatter_kv(
+            state["pool_k"], state.get("scale_k"), flat,
+            cache["cross_k"][0].transpose(1, 0, 2),
         )
-        new["pool_v"] = state["pool_v"].at[flat].set(
-            cache["cross_v"][0].transpose(1, 0, 2).astype(state["pool_v"].dtype)
+        new["pool_v"], scale_v = paged_ops.scatter_kv(
+            state["pool_v"], state.get("scale_v"), flat,
+            cache["cross_v"][0].transpose(1, 0, 2),
         )
+        if scale_k is not None:
+            new["scale_k"], new["scale_v"] = scale_k, scale_v
     new["stack_k"] = tuple(
         upd(d, s) for d, s in zip(state["stack_k"], cache["stack_k"])
     )
@@ -412,21 +427,39 @@ def _build_shared_prefill_executor(model, config: GenerationConfig, chunk: int,
             flat = paged_ops.flat_write_indices(table, pos[None, :], block_size)
             ok = (pos >= lo) & (pos < hi)
             flat = jnp.where(ok[None, :], flat, pos[None, :] % block_size)
-            pool_k = state["pool_k"].at[flat[0]].set(
-                k_c[0].transpose(1, 0, 2).astype(state["pool_k"].dtype)
+            # scatter_kv quantizes when the state carries scales (paged_int8)
+            pool_k, scale_k = paged_ops.scatter_kv(
+                state["pool_k"], state.get("scale_k"), flat[0],
+                k_c[0].transpose(1, 0, 2),
             )
-            pool_v = state["pool_v"].at[flat[0]].set(
-                v_c[0].transpose(1, 0, 2).astype(state["pool_v"].dtype)
+            pool_v, scale_v = paged_ops.scatter_kv(
+                state["pool_v"], state.get("scale_v"), flat[0],
+                v_c[0].transpose(1, 0, 2),
             )
-            return {**state, "pool_k": pool_k, "pool_v": pool_v}
+            out = {**state, "pool_k": pool_k, "pool_v": pool_v}
+            if scale_k is not None:
+                out["scale_k"], out["scale_v"] = scale_k, scale_v
+            return out
 
         def fin(state):
-            logits, pool_k, pool_v, cache, length, m_out = model.apply(
+            quant = "scale_k" in state
+            scale_kwargs = (
+                {"scale_k": state["scale_k"], "scale_v": state["scale_v"]}
+                if quant else {}
+            )
+            outs = model.apply(
                 {"params": params}, window, pad_count, m,
                 state["pool_k"], state["pool_v"], table_row, block_size,
-                method=_prefill_finalize_paged,
+                method=_prefill_finalize_paged, **scale_kwargs,
             )
-            state = {**state, "pool_k": pool_k, "pool_v": pool_v}
+            if quant:
+                (logits, pool_k, pool_v, scale_k, scale_v, cache, length,
+                 m_out) = outs
+                state = {**state, "pool_k": pool_k, "pool_v": pool_v,
+                         "scale_k": scale_k, "scale_v": scale_v}
+            else:
+                logits, pool_k, pool_v, cache, length, m_out = outs
+                state = {**state, "pool_k": pool_k, "pool_v": pool_v}
             return _insert_row(
                 state, slot, window=window, pad=pad_count, logits=logits,
                 cache=cache, length=length, m=m_out,
@@ -454,7 +487,17 @@ def _build_page_copy_executor(block_size: int, out_shardings=None):
         idx_dst = dst * block_size + jnp.arange(block_size)
         pool_k = state["pool_k"].at[idx_dst].set(state["pool_k"][idx_src])
         pool_v = state["pool_v"].at[idx_dst].set(state["pool_v"][idx_src])
-        return {**state, "pool_k": pool_k, "pool_v": pool_v}
+        out = {**state, "pool_k": pool_k, "pool_v": pool_v}
+        if "scale_k" in state:
+            # int8 layout: a COW'd page's dequant scales travel with its
+            # content — already-quantized rows copy bit-exact, no requant
+            out["scale_k"] = state["scale_k"].at[idx_dst].set(
+                state["scale_k"][idx_src]
+            )
+            out["scale_v"] = state["scale_v"].at[idx_dst].set(
+                state["scale_v"][idx_src]
+            )
+        return out
 
     return _jit(run, _donate(0), out_shardings)
 
@@ -513,11 +556,21 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
             if boundary and boundary_mode == "cached":
                 write_ok = ~is_b  # boundary rows' appends belong to the
                 # boundary step below (dense select semantics)
-            logits_a, pool_k, pool_v, stack_a, _, _ = model.apply(
+            quant = "scale_k" in state  # paged_int8: scales ride along
+            scale_kwargs = (
+                {"scale_k": state["scale_k"], "scale_v": state["scale_v"]}
+                if quant else {}
+            )
+            outs = model.apply(
                 {"params": params}, token, state["pool_k"], state["pool_v"],
                 table, stack_cache, length, m, block_size, write_ok,
-                method=_slot_decode_step_paged,
+                method=_slot_decode_step_paged, **scale_kwargs,
             )
+            if quant:
+                logits_a, pool_k, pool_v, scale_k, scale_v, stack_a, _, _ = outs
+            else:
+                logits_a, pool_k, pool_v, stack_a, _, _ = outs
+                scale_k = scale_v = None
             new_logits = logits_a
             stack_k, stack_v = stack_a["stack_k"], stack_a["stack_v"]
             if boundary and boundary_mode == "recompute":
@@ -528,11 +581,18 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
                 )
                 new_logits = jnp.where(is_b[:, None], logits_b, logits_a)
             elif boundary:
-                logits_b, pool_k, pool_v, _ = model.apply(
+                b_scale_kwargs = (
+                    {"scale_k": scale_k, "scale_v": scale_v} if quant else {}
+                )
+                outs_b = model.apply(
                     {"params": params}, window, pad, pool_k, pool_v, table,
                     length, block_size, is_b,
-                    method=_decode_step_boundary_paged,
+                    method=_decode_step_boundary_paged, **b_scale_kwargs,
                 )
+                if quant:
+                    logits_b, pool_k, pool_v, scale_k, scale_v, _ = outs_b
+                else:
+                    logits_b, pool_k, pool_v, _ = outs_b
                 r4 = is_b[:, None, None, None]
                 new_logits = jnp.where(is_b[:, None], logits_b, logits_a)
                 # boundary rows' stack caches are stale by construction
@@ -550,6 +610,8 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
                 "pool_k": pool_k, "pool_v": pool_v,
                 "stack_k": tuple(stack_k), "stack_v": tuple(stack_v),
             }
+            if quant:
+                new_state["scale_k"], new_state["scale_v"] = scale_k, scale_v
             return new_state, token
 
         def run_paged(params, state, table, rng):
@@ -831,6 +893,8 @@ class SlotServingEngine(ServingEngine):
             "kv_prefix_cow_copies_total",
             "kv_prefix_evicted_blocks_total",
             "kv_prefix_published_blocks_total",
+            "kv_quant_fallback_total",
+            "kv_ragged_kernel_steps_total",
         )
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._admitting: Optional[_ChunkedAdmit] = None
@@ -855,12 +919,12 @@ class SlotServingEngine(ServingEngine):
         #: admits exactly what dense would
         self.kv_blocks = int(kv_blocks or self.slots * self._pages_per_slot())
         resolved = decode_strategy_mod.resolve_kv_layout(kv_layout, model)
-        if self._kv_sized and resolved != "paged":
+        if self._kv_sized and resolved not in decode_strategy_mod.PAGED_KV_LAYOUTS:
             raise ValueError(
                 "kv_block_size/kv_blocks size the paged pool but the KV "
                 f"layout resolved to {resolved!r} — the budget would be "
-                "silently ignored; pass kv_layout='paged' (sizing the pool "
-                "is choosing the paged layout)"
+                "silently ignored; pass kv_layout='paged' or 'paged_int8' "
+                "(sizing the pool is choosing the paged layout)"
             )
         # -- prefix cache (docs/serving.md "Prefix sharing") ---------------
         # cross-request copy-on-write sharing of hot prompt-prefix blocks;
@@ -881,12 +945,13 @@ class SlotServingEngine(ServingEngine):
         self._prefix_pref = decode_strategy_mod.resolve_prefix_cache(
             prefix_cache, model
         )
-        if prefix_cache == "on" and resolved != "paged" and kv_layout != "auto":
+        if prefix_cache == "on" and kv_layout != "auto" and \
+                resolved not in decode_strategy_mod.PAGED_KV_LAYOUTS:
             raise ValueError(
                 "prefix_cache='on' shares pool blocks between requests but "
                 f"the KV layout resolved to {resolved!r} — prefix sharing "
-                "requires kv_layout='paged' (dense slots have no block "
-                "tables to share)"
+                "requires kv_layout='paged' (or 'paged_int8'; dense slots "
+                "have no block tables to share)"
             )
         self._kv_counter_base = {"allocs": 0, "frees": 0}
         self._kv_waiting_id: Optional[int] = None  # last head counted waiting
@@ -905,10 +970,10 @@ class SlotServingEngine(ServingEngine):
     # -- KV state/pool lifecycle --------------------------------------------
     def _init_kv_state(self, layout: str) -> None:
         """(Re)build the persistent device state and host allocator for
-        ``layout`` ("dense" | "paged") and publish the capacity/resident
-        gauges. Also the warmup-time layout-switch path (an explicit
-        ``kv_layout="auto"`` re-resolving after the autotuner) — callers
-        must guarantee no residents."""
+        ``layout`` ("dense" | "paged" | "paged_int8") and publish the
+        capacity/resident gauges. Also the warmup-time layout-switch path
+        (an explicit ``kv_layout="auto"`` re-resolving after the
+        autotuner) — callers must guarantee no residents."""
         from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
 
         model, params = self.model, self.params
@@ -923,13 +988,14 @@ class SlotServingEngine(ServingEngine):
                     "caches and head projections are head-sharded — shrink "
                     "the model axis or pad the head count"
                 )
-        if layout == "paged":
+        if layout in decode_strategy_mod.PAGED_KV_LAYOUTS:
             self._pool: Optional[KVPagePool] = KVPagePool(
                 self.kv_blocks, self.kv_block_size, self.slots, model.max_seq_len
             )
             self._state = self._place_state(_blank_state(
                 model, params, self.slots, self.config.pad_token_id,
                 pool_tokens=self._pool_tokens(),
+                quantized=(layout == "paged_int8"),
             ))
             self._table_dev = self._place_table(self._pool.table())
             # a state rebuild zeroes the device pool, so the prefix index
@@ -956,28 +1022,58 @@ class SlotServingEngine(ServingEngine):
         #: the projection trace, so the index flushes rather than serve
         #: values from the other regime
         self._prefix_env = trace_env_fingerprint()
-        # analytic worst-case slot-KV footprint (the old
-        # kv_cache_resident_bytes meaning): dense per-slot cross caches at
-        # full context + the dense latent-stack caches — exact on every
+        # analytic worst-case slot-KV footprint: per-position byte cost
+        # computed from the RESOLVED layout's pool dtype (int8 pools store
+        # 1-byte entries plus f32 per-(position, head) dequant scales —
+        # pretending bf16/f32 here would overstate capacity 2-4x and admit
+        # too little) + the dense latent-stack caches — exact on every
         # backend, device memory_stats() or not (docs/observability.md)
         _, cache_s = _prefill_shapes(model, params)
         _, h, n, d = cache_s["cross_k"].shape
-        itemsize = jnp.dtype(cache_s["cross_k"].dtype).itemsize
+        pool_dtype = (
+            self._state["pool_k"].dtype if self._pool is not None
+            else cache_s["cross_k"].dtype
+        )
+        itemsize = jnp.dtype(pool_dtype).itemsize
         self._kv_token_bytes = 2 * h * d * itemsize  # k + v, per position
+        #: int8 layouts carry one f32 scale per (position, head) per tensor;
+        #: zero for exact layouts so downstream sums stay layout-agnostic
+        self._kv_scale_token_bytes = (
+            2 * h * jnp.dtype(jnp.float32).itemsize
+            if "scale_k" in self._state else 0
+        )
         self._kv_stack_bytes = sum(
             int(leaf.nbytes)
             for name in ("stack_k", "stack_v")
             for leaf in self._state[name]
         )
-        self._kv_capacity_bytes = (
-            self.slots * n * self._kv_token_bytes + self._kv_stack_bytes
-        )
+        if self._pool is not None:
+            # paged capacity is what the POOL can hold (operators size it
+            # via kv_blocks), not the dense worst case
+            self._kv_capacity_bytes = (
+                self.kv_blocks * self.kv_block_size
+                * (self._kv_token_bytes + self._kv_scale_token_bytes)
+                + self._kv_stack_bytes
+            )
+        else:
+            self._kv_capacity_bytes = (
+                self.slots * n * self._kv_token_bytes + self._kv_stack_bytes
+            )
         self.registry.set_gauge("kv_cache_capacity_bytes", self._kv_capacity_bytes)
         if self._pool is not None:
             self.registry.set_gauge("kv_pool_blocks", self._pool.num_blocks)
             self.registry.set_gauge(
                 "kv_pool_block_bytes", self.kv_block_size * self._kv_token_bytes
             )
+            self.registry.set_gauge(
+                "kv_pool_block_scale_bytes",
+                self.kv_block_size * self._kv_scale_token_bytes,
+            )
+        from perceiver_io_tpu.ops import ragged_attention as ragged_mod
+        self.registry.set_gauge(
+            "kv_ragged_kernel_enabled",
+            1 if (self._pool is not None and ragged_mod.kernel_enabled()) else 0,
+        )
         if self.sharding is not None:
             # mesh geometry gauges (docs/observability.md): presence of
             # serving_mesh_devices is how `obs report` knows a mesh ran
@@ -1001,7 +1097,8 @@ class SlotServingEngine(ServingEngine):
             resident = self._kv_capacity_bytes
         else:
             resident = (
-                pool.in_use * self.kv_block_size * self._kv_token_bytes
+                pool.in_use * self.kv_block_size
+                * (self._kv_token_bytes + self._kv_scale_token_bytes)
                 + self._kv_stack_bytes
             )
             self.registry.set_gauge("kv_pool_blocks_in_use", pool.in_use)
@@ -1080,8 +1177,8 @@ class SlotServingEngine(ServingEngine):
         # every executor, so it must key them; dense keys stay identical to
         # the pre-paged ones
         kv = (
-            ("paged", self.kv_block_size, self.kv_blocks)
-            if self.kv_layout == "paged" else ()
+            (self.kv_layout, self.kv_block_size, self.kv_blocks)
+            if self.kv_layout in decode_strategy_mod.PAGED_KV_LAYOUTS else ()
         )
         # mesh geometry (axis sizes + concrete device ids) specializes every
         # executor — shardings are baked into the compiled program, so a
@@ -1109,16 +1206,19 @@ class SlotServingEngine(ServingEngine):
             "trace_env": trace_env_fingerprint(),
             **extra,
         }
-        if self.kv_layout == "paged":
+        if self.kv_layout in decode_strategy_mod.PAGED_KV_LAYOUTS:
             components["kv_layout"] = (
-                f"paged:{self.kv_blocks}x{self.kv_block_size}"
+                f"{self.kv_layout}:{self.kv_blocks}x{self.kv_block_size}"
             )
         if self.sharding is not None:
             components["mesh"] = self.sharding.describe()
         return components
 
     def _kv_block_size_arg(self) -> Optional[int]:
-        return self.kv_block_size if self.kv_layout == "paged" else None
+        return (
+            self.kv_block_size
+            if self.kv_layout in decode_strategy_mod.PAGED_KV_LAYOUTS else None
+        )
 
     # -- sharded-executor helpers (docs/serving.md "Sharded serving"). All
     # None on the unsharded engine; computed only inside cached_executor's
@@ -1145,7 +1245,8 @@ class SlotServingEngine(ServingEngine):
 
     def _gather_sharding(self):
         """Constraint for the paged attend's transient dense gather."""
-        if self.sharding is None or self.kv_layout != "paged":
+        if self.sharding is None or \
+                self.kv_layout not in decode_strategy_mod.PAGED_KV_LAYOUTS:
             return None
         return self.sharding.named(self.sharding.gathered_kv_spec())
 
@@ -1304,12 +1405,21 @@ class SlotServingEngine(ServingEngine):
             # (docs/serving.md "Prefix sharing"; the gate is where
             # feasibility accounts for shareable blocks).
             if need > self._pool.num_blocks:
+                # byte figures from the RESOLVED layout's pool dtype (int8
+                # positions cost 1 byte + f32 scales, not bf16/f32) so the
+                # reason states the pool's TRUE capacity, not an assumed one
+                per_block = self._pool.block_size * (
+                    self._kv_token_bytes + self._kv_scale_token_bytes
+                )
                 raise ValueError(
                     f"request needs {need} KV blocks ({tokens} positions at "
-                    f"block size {self._pool.block_size}) but the pool holds "
-                    f"{self._pool.num_blocks}: it can never be admitted — "
-                    "raise kv_blocks (--serve.kv_blocks) or route it to the "
-                    "dense layout / bucket engine"
+                    f"block size {self._pool.block_size}, "
+                    f"{need * per_block} bytes as {self.kv_layout!r}) but "
+                    f"the pool holds {self._pool.num_blocks} blocks "
+                    f"({self._pool.num_blocks * per_block} bytes): it can "
+                    "never be admitted — raise kv_blocks "
+                    "(--serve.kv_blocks) or route it to the dense layout / "
+                    "bucket engine"
                 )
         return cfg
 
@@ -1817,6 +1927,7 @@ class SlotServingEngine(ServingEngine):
         self._state = self._place_state(_blank_state(
             self.model, self.params, self.slots, self.config.pad_token_id,
             pool_tokens=pool_tokens,
+            quantized=(self.kv_layout == "paged_int8"),
         ))
         self._update_slot_gauges()
         return failed
@@ -2178,6 +2289,12 @@ class SlotServingEngine(ServingEngine):
         if self.profiler_trigger is not None:
             self.profiler_trigger.observe(decode_ms)
         self.registry.inc("serving_decode_steps_total")
+        if self._pool is not None:
+            from perceiver_io_tpu.ops import ragged_attention as ragged_mod
+            if ragged_mod.kernel_enabled():
+                # decode steps served by the ragged paged-attention kernel
+                # (vs the gather-to-dense reference) — docs/observability.md
+                self.registry.inc("kv_ragged_kernel_steps_total")
         self.registry.inc("serving_decode_rows_total", self.slots)
         self.registry.inc("serving_decode_rows_padded_total", self.slots - len(active))
         self.registry.inc("serving_tokens_generated_total", len(active))
@@ -2290,12 +2407,20 @@ class SlotServingEngine(ServingEngine):
                 self._init_kv_state(verdict)
             else:
                 self._update_kv_gauges()
-            if self.prefix_cache_requested == "on" and self.kv_layout != "paged":
+            entry = decode_strategy_mod.kv_entry(self.model)
+            gate = (entry or {}).get("quant_gate")
+            if gate is not None and not gate.get("passed", False):
+                # the quality gate vetoed int8 at this shape — the verdict
+                # degraded to exact "paged"/dense; surface it on a counter
+                # so a fleet rollout notices quality-driven fallbacks
+                self.registry.inc("kv_quant_fallback_total")
+            if self.prefix_cache_requested == "on" and \
+                    self.kv_layout not in decode_strategy_mod.PAGED_KV_LAYOUTS:
                 # the ctor deferred this check for kv_layout="auto" (the
                 # autotuner could still pick paged); it didn't — an
                 # explicit sharing request must not be dropped silently
                 raise ValueError(
-                    "prefix_cache='on' requires kv_layout='paged' but the "
+                    "prefix_cache='on' requires a paged kv_layout but the "
                     "kv-layout autotuner resolved dense at this shape — "
                     "pass kv_layout='paged' explicitly to share prefixes"
                 )
@@ -2384,6 +2509,7 @@ class SlotServingEngine(ServingEngine):
         self._state = self._place_state(_blank_state(
             self.model, self.params, self.slots, cfg.pad_token_id,
             pool_tokens=self._pool_tokens() if paged else None,
+            quantized=(self.kv_layout == "paged_int8"),
         ))
         return executor_cache_stats()["misses"] - before
 
@@ -2425,11 +2551,19 @@ class SlotServingEngine(ServingEngine):
         if self._pool is not None:
             out["kv_pool"] = {
                 **self._pool.stats(),
+                "layout": self.kv_layout,
+                "dtype": str(jnp.dtype(self._state["pool_k"].dtype)),
                 "admit_waits": int(counts.get("kv_pool_admit_waits_total", 0)),
                 "resident_bytes": int(
                     self.registry.gauge("kv_cache_resident_bytes") or 0
                 ),
                 "capacity_bytes": self._kv_capacity_bytes,
+                "block_bytes": self.kv_block_size * self._kv_token_bytes,
+                "block_scale_bytes":
+                    self.kv_block_size * self._kv_scale_token_bytes,
+                "quant_fallbacks": int(
+                    counts.get("kv_quant_fallback_total", 0)
+                ),
             }
             out["prefix_cache"] = {"enabled": self._prefix_index is not None}
             if self._prefix_index is not None:

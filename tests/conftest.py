@@ -63,6 +63,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "quant_kv: quantized int8 KV pool + ragged paged-attention kernel "
+        "test (int8 blocks with per-(position, head) dequant scales, "
+        "quality-gated autotune, interpreter-mode Pallas parity; "
+        "ops/paged_attention.py, ops/ragged_attention.py, "
+        "serving/slots.py; docs/serving.md \"Quantized KV\"); CPU-fast, "
+        "runs in the tier-1 suite with a per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "prefix_cache: cross-request prefix-sharing test (COW/refcounted "
         "blocks, radix index, suffix-only prefill; serving/kv_pool.py, "
         "serving/slots.py; docs/serving.md \"Prefix sharing\"); CPU-fast, "
