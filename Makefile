@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -201,6 +201,32 @@ prefix-bench:
 	model = CausalLanguageModel(cfg); \
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'prefix_cache': bench._bench_prefix_cache(model, params, cfg)}, indent=2))"
+
+# preemption suite (docs/serving.md "Preemption & priorities"): lazy-
+# admission allocator units, token-identity through preempt/requeue/
+# readmit cycles across dense/paged/int8/prefix-shared/chunked
+# geometries, priority-tier + tenant victim selection, kv.exhaust chaos
+# zero-leak storm, frees_by_cause completeness — CPU-fast, also tier-1,
+# per-test timeout budget via the conftest SIGALRM guard
+preemption:
+	$(PY) -m pytest tests/ -q -m preemption --continue-on-collection-errors
+
+# strict-vs-optimistic admission A/B at the CPU-fallback shape
+# (docs/serving.md "Preemption & priorities"): long-tail declared-max_new
+# workload at ONE simulated HBM budget — max-resident ratio, residents
+# per HBM byte, goodput-under-SLO both ways, preemption/readmission
+# counts, greedy token-identity pin
+preempt-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'preemption': bench._bench_preemption(model, params, cfg)}, indent=2))"
 
 # sharded serving-runtime suite (docs/serving.md "Sharded serving"):
 # 1-device byte parity, 8-virtual-device token parity across dense/paged/

@@ -556,6 +556,26 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "paged_kv": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: preemption A/B (strict vs optimistic admission) ----
+        if left() > 150.0:
+            log("run: preemption A/B (strict vs optimistic admission at "
+                "one budget)")
+            try:
+                pmt = _bench_preemption(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "preemption": pmt})
+                log(f"run: preemption residents "
+                    f"{pmt['optimistic']['max_residents']} vs strict "
+                    f"{pmt['strict']['max_residents']} at the same budget "
+                    f"({pmt['max_residents_ratio']}x, goodput_under_slo "
+                    f"{pmt['optimistic']['goodput_under_slo']} vs "
+                    f"{pmt['strict']['goodput_under_slo']}, "
+                    f"{pmt['optimistic']['preemptions']} preemptions, "
+                    f"token_identical={pmt['token_identical']})")
+            except Exception as e:
+                log(f"run: preemption A/B failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "preemption": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: quantized-KV A/B (exact vs int8 pool at one budget) ----
         if left() > 150.0:
             log("run: quant-KV A/B (exact vs int8 paged pool at one budget)")
@@ -1252,6 +1272,153 @@ def _bench_paged_kv(model, params, cfg, *, dense_slots: int = 4,
         "paged_vs_dense_tokens_ratio": round(
             (useful_tokens / paged_dt) / (useful_tokens / dense_dt), 2
         ),
+        "token_identical": token_identical,
+    }
+
+
+def _bench_preemption(model, params, cfg, *, budget_slots: int = 3,
+                      engine_slots: int = 10, n_requests: int = 24,
+                      block_size: int = None):
+    """Strict-reservation vs optimistic-admission A/B at ONE simulated HBM
+    budget (ISSUE 17 acceptance; docs/serving.md "Preemption &
+    priorities") on a long-tail ``max_new`` workload: most requests decode
+    a couple of tokens, ~1 in 6 declares a near-context ``max_new`` cap.
+    The strict arm (``preemption=off``) reserves every resident's WORST
+    CASE up front, so each long-tail request pins near a context-length of
+    pool blocks it mostly never maps, and short requests queue behind that
+    paper debt. The optimistic arm (``preemption="recompute"``) admits on
+    prompt pages + headroom and reclaims real pages by preempting victims
+    (recompute-from-prompt replay) only on genuine exhaustion — packing
+    strictly more concurrent residents into the SAME bytes.
+
+    Recorded acceptance numbers: ``max_residents_ratio`` and
+    ``residents_per_hbm_byte`` per arm (the packing win),
+    ``goodput_under_slo`` per arm — the fraction of requests completing
+    within an SLO pinned at the STRICT arm's p50 completion latency, so
+    the strict arm scores ~0.5 by construction and the optimistic arm
+    beats it by finishing the short tail sooner — the preemption /
+    readmission counts actually exercised, and the greedy token-identity
+    check between the arms (preempt/replay must be invisible in the token
+    stream, the bar pinned by ``tests/test_kv_preemption.py``)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    n = cfg.max_seq_len
+    num_latents = min(4, cfg.max_latents)
+    if block_size is None:
+        block_size = max(4, n // 32)
+    pages_per_slot = -(-n // block_size)
+    short_new = max(2, min(4, cfg.max_latents - num_latents))
+    short_len = max(num_latents, min(64, n // 8))
+    # the long tail declares a near-context max_new CAP — the strict arm
+    # reserves it all up front; actual decode still stops at the cap
+    long_len = short_len
+    long_new = max(short_new + 1, min(n - long_len, model.max_prefix_len))
+    rng = np.random.default_rng(0)
+    base = GenerationConfig(
+        max_new_tokens=short_new, num_latents=num_latents,
+        sampling=SamplingConfig(temperature=0.0),  # greedy: identity check
+    )
+    long_cfg = dataclasses.replace(base, max_new_tokens=long_new)
+    reqs = []
+    for i in range(n_requests):
+        cfg_i = long_cfg if i % 3 == 1 else base
+        reqs.append((
+            rng.integers(1, cfg.vocab_size, size=short_len, dtype=np.int32),
+            cfg_i,
+        ))
+    useful_tokens = sum(c.max_new_tokens for _, c in reqs)
+    table = BucketTable(prompt_lens=(short_len,), batch_sizes=(1,))
+    budget_blocks = budget_slots * pages_per_slot  # the simulated budget
+
+    def run(preemption):
+        def make_engine():
+            return SlotServingEngine(
+                model, params, base, table, slots=engine_slots,
+                kv_layout="paged", kv_block_size=block_size,
+                kv_blocks=budget_blocks, preemption=preemption,
+                admit_headroom_blocks=1 if preemption else 0,
+            )
+        compile_engine = make_engine()
+        for p, c in reqs:
+            compile_engine.submit(p, config=c)
+        compile_engine.run_until_idle()
+        engine = make_engine()
+        handles = [engine.submit(p, config=c) for p, c in reqs]
+        done_at = [None] * len(handles)
+        max_residents = 0
+        t0 = time.perf_counter()
+        while engine.pending():
+            engine.step()
+            now = time.perf_counter() - t0
+            active = sum(1 for s in engine._slots if s is not None)
+            if engine._admitting is not None:
+                active += 1
+            max_residents = max(max_residents, active)
+            for i, h in enumerate(handles):
+                if done_at[i] is None and h.done:
+                    done_at[i] = now
+        dt = time.perf_counter() - t0
+        outs = [h.result for h in handles]
+        return engine, dt, max_residents, outs, done_at
+
+    strict_engine, strict_dt, strict_res, strict_outs, strict_done = run(None)
+    lazy_engine, lazy_dt, lazy_res, lazy_outs, lazy_done = run("recompute")
+    token_identical = all(
+        a is not None and b is not None and bool(np.array_equal(a, b))
+        for a, b in zip(strict_outs, lazy_outs)
+    )
+    # SLO pinned at the strict arm's p50 completion latency: the strict
+    # arm scores ~0.5 by construction, so goodput_under_slo is directly
+    # comparable across arms without picking a magic number
+    slo_s = float(np.median([t for t in strict_done if t is not None]))
+
+    def arm(engine, dt, residents, done, preemption):
+        pool = engine.stats()["kv_pool"]
+        pre = engine.stats().get("preemption") or {}
+        token_bytes = engine._kv_token_bytes
+        budget_bytes = budget_blocks * block_size * token_bytes
+        return {
+            "preemption": preemption or "off",
+            "max_residents": residents,
+            "residents_per_hbm_byte": round(residents / budget_bytes, 12),
+            "tokens_per_sec": round(useful_tokens / dt, 1),
+            "goodput_under_slo": round(
+                sum(1 for t in done if t is not None and t <= slo_s)
+                / len(done), 4
+            ),
+            "preemptions": int(pre.get("preemptions", 0)),
+            "readmissions": int(pre.get("readmissions", 0)),
+            "blocks_high_water": pool["high_water"],
+            "admit_waits": pool["admit_waits"],
+        }
+
+    return {
+        "workload": {
+            "requests": n_requests,
+            "useful_tokens": useful_tokens,
+            "prompt_len": short_len,
+            "short_max_new": short_new,
+            "long_max_new": long_new,
+            "long_fraction": round(sum(1 for _, c in reqs if c is long_cfg)
+                                   / n_requests, 3),
+            "block_size": block_size,
+            "hbm_budget_blocks": budget_blocks,
+            "slo_s": round(slo_s, 4),
+        },
+        "strict": arm(strict_engine, strict_dt, strict_res, strict_done,
+                      None),
+        "optimistic": arm(lazy_engine, lazy_dt, lazy_res, lazy_done,
+                          "recompute"),
+        "max_residents_ratio": round(lazy_res / max(1, strict_res), 2),
         "token_identical": token_identical,
     }
 

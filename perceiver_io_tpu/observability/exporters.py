@@ -103,6 +103,9 @@ HELP_TEXT = {
     "kv_prefix_evicted_blocks_total": "Cached prefix blocks LRU-dropped from the index under pool pressure.",
     "kv_prefix_published_blocks_total": "Full prefix blocks published into the prefix index after admission.",
     "kv_prefix_cached_blocks": "Pool blocks currently retained by the prefix index.",
+    "kv_preemptions_total": "Residents preempted under pool pressure: pages returned, request requeued for recompute-from-prompt replay (docs/serving.md \"Preemption & priorities\").",
+    "kv_readmissions_total": "Previously preempted requests readmitted to a slot (each eventually completing token-identically).",
+    "kv_pool_headroom_blocks": "Free pool blocks beyond the sum of live reservations — the lazy-admission safety margin; 0 means the next boundary crossing may preempt.",
     "executor_resident_bytes": "Sum of recorded executors' temp+output bytes (XLA memory analysis).",
     "trainer_steps_total": "Executed optimizer steps (skipped steps included).",
     "trainer_skipped_steps_total": "Steps discarded by the non-finite skip policy.",

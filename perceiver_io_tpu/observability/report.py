@@ -469,6 +469,19 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
             "published_blocks": c("kv_prefix_published_blocks_total"),
             "cached_blocks": g("kv_prefix_cached_blocks"),
         }
+    # preemption rollup (docs/serving.md "Preemption & priorities"):
+    # preempt/readmit churn plus the live free-beyond-reservations
+    # headroom gauge. None when the run never preempted AND never ran
+    # lazily — strict-admission artifacts stay unchanged.
+    preemption = None
+    preempts = c("kv_preemptions_total")
+    headroom = g("kv_pool_headroom_blocks")
+    if preempts is not None or headroom is not None:
+        preemption = {
+            "preemptions": preempts or 0,
+            "readmissions": c("kv_readmissions_total") or 0,
+            "headroom_blocks": headroom,
+        }
     return {
         "blocks": int(blocks),
         "blocks_in_use": in_use,
@@ -494,6 +507,7 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
         "ragged_kernel_enabled": g("kv_ragged_kernel_enabled"),
         "ragged_kernel_steps": c("kv_ragged_kernel_steps_total"),
         "prefix_cache": prefix,
+        "preemption": preemption,
     }
 
 
@@ -930,6 +944,16 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                 f"prefix churn: published={pc['published_blocks']} "
                 f"evicted={pc['evicted_blocks']} cow={pc['cow_copies']} "
                 f"cached_now={pc['cached_blocks']}"
+            )
+        pre = kv.get("preemption")
+        if pre:
+            out.append(
+                f"preemption: {pre['preemptions']} preempted, "
+                f"{pre['readmissions']} readmitted"
+                + (
+                    f"  headroom_blocks={pre['headroom_blocks']}"
+                    if pre["headroom_blocks"] is not None else ""
+                )
             )
 
     mesh = analysis.get("sharding")

@@ -187,7 +187,10 @@ def _pad_spec(bj, by_dim2=False):
     return pl.BlockSpec((1, bj), lambda b_, h_, x_, y_: (b_, y_))
 
 
-_DIM_SEMANTICS = pltpu.CompilerParams(
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept
+# whichever the pinned version exposes.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+_DIM_SEMANTICS = _COMPILER_PARAMS(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
 )
 

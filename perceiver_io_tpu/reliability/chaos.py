@@ -60,6 +60,14 @@ site                        keying
                             removal still completes — the failed-over
                             work is already safe on survivors
                             (:meth:`ChaosRegistry.crash_scale_down`)
+``kv.exhaust``              execution count (1-based): the Nth decode step
+                            the slot engine ran with preemption enabled.
+                            ``error`` forces the first resident's page
+                            mapping down the ``PoolExhausted`` path that
+                            step — scripted memory pressure driving the
+                            boundary-crossing preemption machinery without
+                            filling the pool
+                            (:meth:`ChaosRegistry.exhaust_kv`)
 ==========================  =============================================
 
 Fault kinds: ``"error"`` (the site raises — or records — an exception),
@@ -232,6 +240,16 @@ class ChaosRegistry:
         request is lost (the drill's pin)."""
         return self.add("fleet.scale_down", "error", attempt, count=count,
                         exc_factory=exc_factory)
+
+    def exhaust_kv(self, step: int, *, count: int = 1) -> Fault:
+        """Script KV-pool pressure: the slot engine (with preemption
+        enabled) consults ``kv.exhaust`` once per decode step (1-based)
+        and an ``error`` fault forces the first resident's page mapping
+        down the :class:`PoolExhausted` path that step — a deterministic
+        preemption storm with no need to actually fill the pool
+        (docs/serving.md "Preemption & priorities"; the zero-leak drill
+        in ``tests/test_kv_preemption.py``)."""
+        return self.add("kv.exhaust", "error", step, count=count)
 
     def fail_dispatch(self, attempt: int, *, count: int = 1) -> Fault:
         """Fail the router's ``attempt``-th dispatch attempt (1-based,

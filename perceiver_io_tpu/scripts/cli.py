@@ -291,6 +291,19 @@ class ServeArgs:
     #: PERCEIVER_PREFIX_CACHE then the measured registry (off when
     #: unrecorded). ``on`` requires --serve.kv_layout=paged.
     prefix_cache: str = "auto"
+    #: preemption mode for the paged slot engine (docs/serving.md
+    #: "Preemption & priorities"): ``recompute`` switches admission to
+    #: optimistic lazy paging — requests admit when their PROMPT pages
+    #: (plus --serve.admit_headroom_blocks) fit rather than reserving the
+    #: worst case up front, and on genuine pool exhaustion the engine
+    #: preempts the lowest-priority victim (pages returned, request
+    #: requeued, greedy replay token-identical). ``off`` (default) keeps
+    #: strict worst-case reservations. Requires a paged --serve.kv_layout.
+    preemption: Optional[str] = None
+    #: decode headroom blocks granted beyond the prompt at lazy admission
+    #: (--serve.preemption only): higher = fewer early preemptions, lower
+    #: = more residents per HBM byte. Default 0.
+    admit_headroom_blocks: int = 0
     #: prompt-length bucket grid; default = powers of two up to the context
     prompt_buckets: Optional[typing.Tuple[int, ...]] = None
     #: micro-batch size grid (``bucket`` engine; ignored by ``slots``)
@@ -1054,6 +1067,27 @@ class CLI:
                 raise SystemExit(
                     f"--serve.replicas must be >= 1, got {args.replicas}"
                 )
+            if args.preemption is not None:
+                from perceiver_io_tpu.serving.slots import PREEMPTION_MODES
+
+                if args.preemption not in PREEMPTION_MODES:
+                    raise SystemExit(
+                        "--serve.preemption must be one of "
+                        f"{PREEMPTION_MODES}, got {args.preemption!r}"
+                    )
+            if args.admit_headroom_blocks < 0:
+                raise SystemExit(
+                    "--serve.admit_headroom_blocks must be >= 0, got "
+                    f"{args.admit_headroom_blocks}"
+                )
+            if args.admit_headroom_blocks and args.preemption is None:
+                # inapplicable-flag convention: headroom only shapes lazy
+                # admission, which --serve.preemption enables
+                raise SystemExit(
+                    "--serve.admit_headroom_blocks applies with "
+                    "--serve.preemption (strict reservations already "
+                    "cover the worst case)"
+                )
             autoscale = args.autoscale
             if autoscale.max is None and any(
                 k.startswith("serve.autoscale.") for k in values
@@ -1162,6 +1196,8 @@ class CLI:
                         prefill_chunk=args.prefill_chunk,
                         kv_layout=kv_mode, kv_block_size=args.kv_block_size,
                         kv_blocks=args.kv_blocks, prefix_cache=prefix_mode,
+                        preemption=args.preemption,
+                        admit_headroom_blocks=args.admit_headroom_blocks,
                         mesh=(
                             mesh_alloc.acquire() if mesh_alloc is not None
                             else None
@@ -1197,6 +1233,14 @@ class CLI:
                         "--serve.prefix_cache applies to --serve.engine=slots "
                         "with the paged KV layout (the bucket engine has no "
                         "block tables to share)"
+                    )
+                if args.preemption is not None \
+                        or args.admit_headroom_blocks != 0:
+                    raise SystemExit(
+                        "--serve.preemption/--serve.admit_headroom_blocks "
+                        "apply to --serve.engine=slots with a paged KV "
+                        "layout (the bucket engine has no page pool to "
+                        "preempt from)"
                     )
 
                 def make_engine():

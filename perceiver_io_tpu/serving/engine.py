@@ -179,6 +179,21 @@ class ServeRequest:
     #: isolated (``serving_token_sink_errors_total``), never failing the
     #: request it observes.
     on_token: Optional[Callable[[int, int], None]] = None
+    #: scheduling tier (docs/serving.md "Preemption & priorities"): HIGHER
+    #: int = more important. The slot engine admits higher tiers first and
+    #: — under optimistic KV admission — preempts strictly-lower tiers
+    #: when the pool runs dry ("interactive preempts batch, never vice
+    #: versa"). 0 (default) keeps pure FIFO; the bucket engine stores but
+    #: ignores it.
+    priority: int = 0
+    #: tenant label for per-tenant resident-page fairness under preemption
+    #: (victim selection prefers the tenant holding the most pool pages at
+    #: equal priority). None = untagged.
+    tenant: Optional[str] = None
+    #: times this request was preempted (pages returned, requeued for a
+    #: token-identical greedy replay) — ``serving.readmitted`` span events
+    #: and the replay dedupe contract key off it
+    preemptions: int = 0
 
     @property
     def ttft_from_s(self) -> float:
@@ -330,7 +345,8 @@ class ServingEngine:
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
                *, deadline_s: Optional[float] = None,
                ttft_anchor_s: Optional[float] = None,
-               on_token: Optional[Callable[[int, int], None]] = None
+               on_token: Optional[Callable[[int, int], None]] = None,
+               priority: int = 0, tenant: Optional[str] = None
                ) -> ServeRequest:
         """Enqueue one prompt (1-D token ids); returns its request handle.
 
@@ -343,6 +359,10 @@ class ServingEngine:
         submit time; the HTTP gateway its socket-accept time — see
         :class:`ServeRequest`). ``on_token`` installs the request's
         incremental token sink (:attr:`ServeRequest.on_token`).
+        ``priority`` (higher = more important) and ``tenant`` tag the
+        request for the slot engine's priority-ordered admission and
+        preemption victim policy (docs/serving.md "Preemption &
+        priorities"); this bucket engine stores them untouched.
         """
         if not self._accepting:
             raise RuntimeError("engine is draining; new submissions rejected")
@@ -376,6 +396,7 @@ class ServingEngine:
             trace_id=self.tracer.new_trace_id() if self.tracer else None,
             ttft_anchor_s=ttft_anchor_s,
             on_token=on_token,
+            priority=int(priority), tenant=tenant,
         )
         self._next_id += 1
         self._queue.append(req)

@@ -159,6 +159,12 @@ class FleetRequest:
     #: determinism makes the replayed prefix identical, so stream
     #: consumers dedupe by index — docs/serving.md "Streaming")
     on_token: Optional[Callable[[int, int], None]] = None
+    #: scheduling tier + tenant label, forwarded to the engine copy at
+    #: every dispatch (docs/serving.md "Preemption & priorities"): the
+    #: fleet dispatches higher tiers first, and a preemption-enabled slot
+    #: engine uses them for victim selection + per-tenant page fairness
+    priority: int = 0
+    tenant: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -534,7 +540,8 @@ class FleetRouter:
     def submit(self, prompt, config: Optional[GenerationConfig] = None,
                *, deadline_s: Optional[float] = None,
                ttft_anchor_s: Optional[float] = None,
-               on_token: Optional[Callable[[int, int], None]] = None
+               on_token: Optional[Callable[[int, int], None]] = None,
+               priority: int = 0, tenant: Optional[str] = None
                ) -> FleetRequest:
         """Enqueue one prompt fleet-wide; returns its durable handle.
 
@@ -546,8 +553,11 @@ class FleetRouter:
         While the SLO monitor reports a sustained burn, the effective
         ``max_pending`` and default deadline are tightened by
         ``slo_shed_factor`` (:meth:`_effective_admission`).
-        ``ttft_anchor_s`` / ``on_token`` are handed to the engine copy at
-        every dispatch (:class:`FleetRequest`).
+        ``ttft_anchor_s`` / ``on_token`` / ``priority`` / ``tenant`` are
+        handed to the engine copy at every dispatch (:class:`FleetRequest`);
+        higher-priority requests dispatch first, and a preemption-enabled
+        slot engine uses the tier + tenant for victim selection
+        (docs/serving.md "Preemption & priorities").
         """
         if not self._accepting:
             raise RuntimeError("fleet is draining; new submissions rejected")
@@ -592,6 +602,7 @@ class FleetRouter:
             trace_id=self.tracer.new_trace_id() if self.tracer else None,
             ttft_anchor_s=ttft_anchor_s,
             on_token=on_token,
+            priority=int(priority), tenant=tenant,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -916,8 +927,10 @@ class FleetRouter:
         disposed = 0
         now = self._clock()
         # the ONE sort site: requeues since the last pass appended without
-        # sorting; dispatch order is FIFO by original submission id
-        self._queue.sort(key=lambda r: r.request_id)
+        # sorting; dispatch order is priority tier first (higher tiers
+        # reach an engine — and its preemption machinery — sooner), FIFO
+        # by original submission id within a tier
+        self._queue.sort(key=lambda r: (-r.priority, r.request_id))
         pending = self._queue
         self._queue = []
         loads: Dict[Replica, int] = {}
@@ -977,6 +990,7 @@ class FleetRouter:
                         else req.ttft_anchor_s
                     ),
                     on_token=req.on_token,
+                    priority=req.priority, tenant=req.tenant,
                 )
             except QueueFull:
                 self._queue.append(req)  # engine backpressure: wait, not a fault

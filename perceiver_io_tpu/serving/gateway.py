@@ -18,7 +18,10 @@ which the gateway drives through the shared request surface from a single
 driver task, preserving the engines' single-owner contract:
 
 - ``POST /v1/generate`` — body ``{"prompt": str | "prompt_ids": [int],
-  "max_new_tokens"?: int, "stream"?: "sse"|"jsonl", "deadline_s"?: s}``.
+  "max_new_tokens"?: int, "stream"?: "sse"|"jsonl", "deadline_s"?: s,
+  "priority"?: int in [-100, 100], "tenant"?: str}``. ``priority`` and
+  ``tenant`` feed the slot engine's preemption tiers and per-tenant
+  fairness accounting (docs/serving.md "Preemption & priorities").
   Each generated token is flushed the moment the slot engine's ``step()``
   materializes it (the per-request ``on_token`` sink,
   :class:`~perceiver_io_tpu.serving.engine.ServeRequest`; batch-granular
@@ -545,7 +548,23 @@ class StreamingGateway:
             ):
                 raise ValueError('"deadline_s" must be a number of seconds')
             deadline_s = float(deadline_s)
-        return prompt, cfg, mode, deadline_s
+        # scheduling tier + tenant tag (docs/serving.md "Preemption &
+        # priorities"): clamped to a small signed range — an
+        # unauthenticated client must not be able to claim an unbounded
+        # tier any more than it can size device buffers
+        priority = payload.get("priority", 0)
+        if isinstance(priority, bool) or not isinstance(priority, int):
+            raise ValueError('"priority" must be an integer')
+        if not -100 <= priority <= 100:
+            raise ValueError('"priority" must be in [-100, 100]')
+        tenant = payload.get("tenant")
+        if tenant is not None:
+            if not isinstance(tenant, str) or not tenant or len(tenant) > 128:
+                raise ValueError(
+                    '"tenant" must be a non-empty string of at most 128 '
+                    "characters"
+                )
+        return prompt, cfg, mode, deadline_s, priority, tenant
 
     def _event_bytes(self, record: dict, mode: str) -> bytes:
         line = json.dumps(record)
@@ -590,7 +609,8 @@ class StreamingGateway:
     async def _handle_generate(self, reader, writer, body: bytes) -> None:
         accepted_at = self._clock()  # the socket-accept TTFT anchor
         try:
-            prompt, cfg, mode, deadline_s = self._parse_generate(body)
+            prompt, cfg, mode, deadline_s, priority, tenant = \
+                self._parse_generate(body)
         except ValueError as e:
             self.registry.inc("gateway_streams_rejected_total")
             await self._respond(writer, 400, {"error": str(e)})
@@ -604,6 +624,7 @@ class StreamingGateway:
             handle = self.engine.submit(
                 prompt, cfg, deadline_s=deadline_s,
                 ttft_anchor_s=accepted_at, on_token=on_token,
+                priority=priority, tenant=tenant,
             )
         except QueueFull as e:
             # backpressure maps to 503 + Retry-After: the engine already

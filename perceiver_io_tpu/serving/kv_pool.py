@@ -131,6 +131,14 @@ class KVPagePool:
         #: accounting that makes abandoned-resident leaks visible instead
         #: of folded into ordinary churn (docs/serving.md "Streaming")
         self.frees_by_cause: Dict[str, int] = {}
+        #: slot -> soft watermark (total pages the slot may EVER map —
+        #: ``ceil((prompt + max_new) / block_size)``) for slots admitted
+        #: through :meth:`reserve_lazy`. Lazy slots hold a hard reservation
+        #: only for their prompt pages (+ headroom); decode pages past it
+        #: allocate straight from the free heap, so :meth:`ensure` becomes
+        #: FALLIBLE for them (:class:`PoolExhausted` = the engine's
+        #: preemption trigger) instead of an accounting-bug ValueError.
+        self._soft: Dict[int, int] = {}
 
     # -- sizing -------------------------------------------------------------
     def blocks_needed(self, tokens: int) -> int:
@@ -229,6 +237,68 @@ class KVPagePool:
         self._reserved[slot] = need
         return need
 
+    def reserve_lazy(self, slot: int, prompt_tokens: int, total_tokens: int,
+                     *, headroom: int = 0, shared_blocks: int = 0) -> int:
+        """Optimistic admission: commit only the blocks the *prompt* needs
+        (plus ``headroom`` decode blocks, clamped to the worst case), and
+        record ``ceil(total_tokens / block_size)`` as a SOFT watermark —
+        the reservation ledger the up-front path hard-commits becomes
+        advisory. Returns the hard-committed count.
+
+        Decode pages past the commitment allocate from the free heap when
+        the resident actually crosses a block boundary; :meth:`ensure` on a
+        lazy slot raises :class:`PoolExhausted` when that heap is dry — the
+        signal the slot engine turns into a preemption instead of an
+        admission-time head-of-line block (docs/serving.md "Preemption &
+        priorities"). Raise semantics at admit mirror :meth:`reserve`:
+        ``ValueError`` for structurally-infeasible or double bookings,
+        :class:`PoolExhausted` when the committed need doesn't fit now.
+        """
+        if self._reserved[slot] or self._mapped[slot]:
+            raise ValueError(f"slot {slot} already holds pool pages/reservation")
+        total = self.blocks_needed(total_tokens)
+        prompt = self.blocks_needed(prompt_tokens)
+        if not 0 <= prompt <= total:
+            raise ValueError(
+                f"prompt_tokens {prompt_tokens} out of range for "
+                f"{total_tokens} total tokens"
+            )
+        if total > self.pages_per_slot:
+            raise ValueError(
+                f"{total_tokens} tokens need {total} blocks but one slot "
+                f"maps at most {self.pages_per_slot}"
+            )
+        if not 0 <= shared_blocks <= prompt:
+            raise ValueError(
+                f"shared_blocks {shared_blocks} out of range for a "
+                f"{prompt}-prompt-block request"
+            )
+        if headroom < 0:
+            raise ValueError(f"headroom must be >= 0, got {headroom}")
+        # hard commitment: private prompt pages + headroom, never more than
+        # the worst case would have taken (headroom can't over-reserve)
+        need = min(prompt - shared_blocks + headroom, total - shared_blocks)
+        if not self.can_reserve(need):
+            raise PoolExhausted(
+                f"need {need} blocks, {self.available} of {self.num_blocks} "
+                "unreserved"
+            )
+        self._reserved[slot] = need
+        self._soft[slot] = total
+        return need
+
+    def is_lazy(self, slot: int) -> bool:
+        """True when ``slot`` was admitted through :meth:`reserve_lazy` —
+        its :meth:`ensure` may raise :class:`PoolExhausted`."""
+        return slot in self._soft
+
+    @property
+    def headroom_blocks(self) -> int:
+        """Free blocks not spoken for by any hard reservation — the real
+        distance to the next :class:`PoolExhausted` on a lazy slot's
+        boundary crossing (the ``kv_pool_headroom_blocks`` gauge)."""
+        return max(0, len(self._free) - sum(self._reserved.values()))
+
     def map_shared(self, slot: int, blocks: Sequence[int]) -> None:
         """Map already-resident blocks as ``slot``'s leading pages by
         reference (one retain each) — the prefix-sharing admit path. Must
@@ -296,18 +366,40 @@ class KVPagePool:
         when any new block was mapped (the caller refreshes gauges and the
         device table). Infallible for positions within the reservation —
         the free-list invariant guarantees a block is available. Pages
-        already mapped (privately or shared) are left untouched."""
+        already mapped (privately or shared) are left untouched.
+
+        Lazy slots (:meth:`reserve_lazy`) may map past their hard
+        reservation up to the soft watermark, allocating from the free
+        heap — but only from blocks no hard reservation has spoken for;
+        when none remains this raises :class:`PoolExhausted` with the slot
+        table UNCHANGED (no partial mapping), the engine's cue to preempt
+        a victim and retry."""
         pages = self.blocks_needed(tokens)
         mapped = self._mapped[slot]
+        soft = self._soft.get(slot)
+        if soft is not None and pages > soft:
+            raise ValueError(
+                f"slot {slot} needs {pages} pages past its soft watermark "
+                f"{soft} — admission accounting bug"
+            )
         changed = False
         while len(mapped) < pages:
-            if self._reserved[slot] <= 0:
-                raise ValueError(
-                    f"slot {slot} mapping page {len(mapped)} past its "
-                    "reservation — admission accounting bug"
-                )
+            from_reservation = self._reserved[slot] > 0
+            if not from_reservation:
+                if soft is None:
+                    raise ValueError(
+                        f"slot {slot} mapping page {len(mapped)} past its "
+                        "reservation — admission accounting bug"
+                    )
+                if len(self._free) <= sum(self._reserved.values()):
+                    raise PoolExhausted(
+                        f"slot {slot} crossing a block boundary at page "
+                        f"{len(mapped)} with no unreserved free block — "
+                        "preempt a victim to continue"
+                    )
             block = self._alloc()
-            self._reserved[slot] -= 1
+            if from_reservation:
+                self._reserved[slot] -= 1
             self._table[slot, len(mapped)] = block
             mapped.append(block)
             changed = True
@@ -329,6 +421,7 @@ class KVPagePool:
             freed += self.deref(block, cause=cause)
         mapped.clear()
         self._reserved[slot] = 0
+        self._soft.pop(slot, None)
         self._table[slot, :] = 0
         return freed
 
@@ -396,6 +489,11 @@ class KVPagePool:
             "shared_maps_total": self.shared_maps_total,
             "shared_derefs_total": self.shared_derefs_total,
             "cow_swaps_total": self.cow_swaps_total,
+            # optimistic-admission accounting (docs/serving.md "Preemption
+            # & priorities"): residents admitted lazily and the distance to
+            # the next boundary-crossing PoolExhausted
+            "lazy_slots": len(self._soft),
+            "headroom_blocks": self.headroom_blocks,
         }
 
 

@@ -101,7 +101,7 @@ faults failing only resident requests while the queue survives.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -128,8 +128,18 @@ from perceiver_io_tpu.inference.generate import (
 from perceiver_io_tpu.inference.samplers import apply_min_new_tokens, sample_logits
 from perceiver_io_tpu.ops import paged_attention as paged_ops
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine, _round_ms
-from perceiver_io_tpu.serving.kv_pool import KVPagePool, PrefixBlockIndex
+from perceiver_io_tpu.serving.kv_pool import (
+    KVPagePool,
+    PoolExhausted,
+    PrefixBlockIndex,
+)
 from perceiver_io_tpu.serving.sharding import as_serving_sharding
+
+#: preemption policies (docs/serving.md "Preemption & priorities"):
+#: ``off`` keeps reserve-worst-case admission; ``recompute`` admits on
+#: prompt pages and replays preempted victims from their original prompt
+#: (token-identical under greedy — no KV state is saved or restored).
+PREEMPTION_MODES = ("off", "recompute")
 
 _EXECUTOR_CACHE: dict = register_executor_cache({})
 
@@ -813,6 +823,22 @@ class SlotServingEngine(ServingEngine):
         are LRU-dropped under pool pressure before an admission is made
         to wait. ``None`` defers to ``PERCEIVER_PREFIX_CACHE`` then the
         measured registry (off when unrecorded).
+    :param preemption: optimistic KV admission + eviction under memory
+        pressure — ``"off" | "recompute"`` (docs/serving.md "Preemption &
+        priorities"; paged layouts only). ``"recompute"`` drops the
+        up-front worst-case reservation: a request admits when its PROMPT
+        pages fit (plus ``admit_headroom_blocks``), decode pages allocate
+        lazily at each block-boundary crossing, and when a crossing finds
+        the pool genuinely dry the engine preempts a victim —
+        lowest-priority-first, then most-pages-held, then fewest-tokens-
+        generated, never a higher tier — returning every page
+        (``frees_by_cause["preempted"]``) and requeueing it for a
+        token-identical greedy replay from its original prompt. ``"off"``
+        (default) keeps the reserve-worst-case admission unchanged.
+    :param admit_headroom_blocks: extra decode blocks hard-committed per
+        lazy admission (``preemption="recompute"`` only) — a small buffer
+        that absorbs the first boundary crossings without triggering
+        preemption; 0 (default) admits on prompt pages alone.
     :param mesh: serving parallelism mesh (docs/serving.md "Sharded
         serving") — a :class:`~perceiver_io_tpu.serving.sharding.
         ServingMeshSpec` (or resolved ``ServingSharding`` / 4-axis training
@@ -838,6 +864,8 @@ class SlotServingEngine(ServingEngine):
                  kv_block_size: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  prefix_cache: Optional[str] = None,
+                 preemption: Optional[str] = None,
+                 admit_headroom_blocks: int = 0,
                  mesh=None, **kwargs):
         super().__init__(
             model, params, config, table, decode_strategy=decode_strategy,
@@ -895,6 +923,8 @@ class SlotServingEngine(ServingEngine):
             "kv_prefix_published_blocks_total",
             "kv_quant_fallback_total",
             "kv_ragged_kernel_steps_total",
+            "kv_preemptions_total",
+            "kv_readmissions_total",
         )
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._admitting: Optional[_ChunkedAdmit] = None
@@ -953,6 +983,34 @@ class SlotServingEngine(ServingEngine):
                 "requires kv_layout='paged' (or 'paged_int8'; dense slots "
                 "have no block tables to share)"
             )
+        # -- preemption (docs/serving.md "Preemption & priorities") --------
+        # optimistic admission is a PAGED property: lazy pages need a block
+        # pool to be lazy about. Same loud-reject discipline as prefix
+        # sharing when the layout resolves dense.
+        if preemption is not None and preemption not in PREEMPTION_MODES:
+            raise ValueError(
+                f"preemption must be one of {PREEMPTION_MODES}, "
+                f"got {preemption!r}"
+            )
+        if admit_headroom_blocks < 0:
+            raise ValueError(
+                "admit_headroom_blocks must be >= 0, got "
+                f"{admit_headroom_blocks}"
+            )
+        self.preemption = preemption or "off"
+        self.admit_headroom_blocks = int(admit_headroom_blocks)
+        if self.preemption != "off" and kv_layout != "auto" and \
+                resolved not in decode_strategy_mod.PAGED_KV_LAYOUTS:
+            raise ValueError(
+                f"preemption={self.preemption!r} admits against the block "
+                f"pool but the KV layout resolved to {resolved!r} — lazy "
+                "pages need kv_layout='paged' (or 'paged_int8'; dense slots "
+                "reserve their worst case by construction)"
+            )
+        #: preemption accounting: tier -> victims preempted at that tier
+        #: (the kv_preemptions_total by-tier breakdown stats() reports)
+        self._preempted_by_tier: Dict[int, int] = {}
+        self._preempts_this_step = 0
         self._kv_counter_base = {"allocs": 0, "frees": 0}
         self._kv_waiting_id: Optional[int] = None  # last head counted waiting
         self._init_kv_state(resolved)
@@ -1104,6 +1162,12 @@ class SlotServingEngine(ServingEngine):
             self.registry.set_gauge("kv_pool_blocks_in_use", pool.in_use)
             self.registry.set_gauge("kv_pool_blocks_reserved", pool.reserved)
             self.registry.set_gauge("kv_pool_blocks_high_water", pool.high_water)
+            # distance to the next boundary-crossing PoolExhausted under
+            # optimistic admission (docs/serving.md "Preemption &
+            # priorities") — free blocks no hard reservation has claimed
+            self.registry.set_gauge(
+                "kv_pool_headroom_blocks", pool.headroom_blocks
+            )
             if self._prefix_index is not None:
                 self.registry.set_gauge(
                     "kv_prefix_cached_blocks", self._prefix_index.cached_blocks
@@ -1498,8 +1562,9 @@ class SlotServingEngine(ServingEngine):
         admissions."""
         pool = self._pool
         L = int(req.prompt.size)
-        pool.reserve(
-            slot, L + req.config.max_new_tokens, shared_blocks=len(plan.nodes)
+        self._reserve_admit(
+            slot, L, req.config.max_new_tokens, shared_blocks=len(plan.nodes),
+            pessimistic=bool(req.preemptions),
         )
         blocks = [node.block for node in plan.nodes]
         if plan.partial is not None:
@@ -1597,6 +1662,219 @@ class SlotServingEngine(ServingEngine):
                 changed = True
         return changed
 
+    # -- preemption (docs/serving.md "Preemption & priorities") --------------
+    def _reserve_admit(self, slot: int, prompt_tokens: int, max_new: int,
+                       *, shared_blocks: int = 0,
+                       pessimistic: bool = False) -> None:
+        """One admission's pool reservation, policy-routed: the worst case
+        up front (``preemption="off"``, or ``pessimistic`` — a replayed
+        victim's anti-thrash guarantee) or lazily — prompt pages plus
+        ``admit_headroom_blocks``, with ``prompt + max_new`` recorded as a
+        soft watermark (:meth:`KVPagePool.reserve_lazy`)."""
+        total = prompt_tokens + max_new
+        if self.preemption == "off" or pessimistic:
+            self._pool.reserve(slot, total, shared_blocks=shared_blocks)
+        else:
+            self._pool.reserve_lazy(
+                slot, prompt_tokens, total,
+                headroom=self.admit_headroom_blocks,
+                shared_blocks=shared_blocks,
+            )
+
+    def _admit_need(self, req: ServeRequest,
+                    plan: Optional[_PrefixPlan]) -> int:
+        """Blocks the admission gate must see reservable before ``req``
+        admits: its worst case (minus referenced prefix blocks) under
+        up-front reservation, or just its private prompt pages + headroom
+        under optimistic admission — the tentpole's capacity win: peak
+        concurrency sized by what residents USE, not what they might.
+
+        Forward-progress exception: a request that has ALREADY been
+        preempted (``req.preemptions > 0``) re-admits under its full worst
+        case. Optimistic readmission livelocks — N long tails each
+        re-entering on a 2-block prompt commit evict each other forever,
+        nobody keeping decode progress. Pessimistic readmission makes the
+        cycle terminate: every preemption moves one request from the
+        optimistic class to the guaranteed class, a guaranteed resident's
+        ``ensure`` draws only on its own reservation (it can never trip
+        exhaustion), and each preemption's beneficiary keeps its tokens —
+        so memory preemptions are bounded by the request count."""
+        shared = len(plan.nodes) if plan is not None else 0
+        tokens = int(req.prompt.size) + req.config.max_new_tokens
+        total = self._pool.blocks_needed(tokens) - shared
+        if self.preemption == "off" or req.preemptions:
+            return total
+        prompt = self._pool.blocks_needed(int(req.prompt.size)) - shared
+        return min(prompt + self.admit_headroom_blocks, total)
+
+    def _tenant_pages(self) -> Dict[Optional[str], int]:
+        """Resident pool pages held per tenant (the in-flight chunked
+        admission included) — the fairness signal victim selection uses:
+        at equal priority, the tenant holding the most pages yields first,
+        so one tenant's long tail cannot starve the rest."""
+        pages: Dict[Optional[str], int] = {}
+        for entry in self._active():
+            t = entry.req.tenant
+            pages[t] = pages.get(t, 0) + self._pool.mapped_blocks(entry.slot)
+        if self._admitting is not None:
+            t = self._admitting.req.tenant
+            pages[t] = pages.get(t, 0) + self._pool.mapped_blocks(
+                self._admitting.slot
+            )
+        return pages
+
+    def _pick_victim(self, priority_cap: int, *, strict: bool,
+                     exclude_slot: int = -1
+                     ) -> Optional[Union[_Slot, _ChunkedAdmit]]:
+        """Deterministic victim policy over residents AND the in-flight
+        chunked admission: never a tier above ``priority_cap`` (above OR AT
+        it when ``strict`` — admission-time preemption crosses tiers only,
+        "interactive preempts batch, never vice versa"), then
+        most-tenant-pages (fairness), most-pages-held (biggest relief),
+        fewest-tokens-generated (cheapest replay), newest request."""
+        tenant_pages = self._tenant_pages()
+
+        def key(req: ServeRequest, slot: int, generated: int):
+            return (
+                req.priority,
+                -tenant_pages.get(req.tenant, 0),
+                -self._pool.mapped_blocks(slot),
+                generated,
+                -req.request_id,
+            )
+
+        def eligible(req: ServeRequest) -> bool:
+            if req.priority > priority_cap:
+                return False
+            return not (strict and req.priority == priority_cap)
+
+        best = None
+        best_key = None
+        for entry in self._active():
+            if entry.slot == exclude_slot or not eligible(entry.req):
+                continue
+            k = key(entry.req, entry.slot, len(entry.emitted))
+            if best_key is None or k < best_key:
+                best, best_key = entry, k
+        admit = self._admitting
+        if admit is not None and admit.slot != exclude_slot \
+                and eligible(admit.req):
+            k = key(admit.req, admit.slot, 0)
+            if best_key is None or k < best_key:
+                best = admit
+        return best
+
+    def _preempt_victim(self, victim: Union[_Slot, _ChunkedAdmit], *,
+                        beneficiary: Optional[int] = None) -> None:
+        """Preempt one victim (default ``recompute-from-prompt`` policy):
+        retire its slot with EVERY page returned
+        (``frees_by_cause["preempted"]`` — a prefix-sharing victim only
+        derefs published blocks, never frees them out from under other
+        sharers), discard its emitted tokens, and requeue the request as a
+        VOLUNTARY replay — status stays ``queued``, no failover-budget
+        analog is charged, and greedy re-decoding from the original prompt
+        is token-identical (the bar ``tests/test_kv_preemption.py`` pins).
+        Stream consumers see ``on_token`` indices restart at 0 on replay
+        and dedupe, exactly like a fleet failover."""
+        req = victim.req
+        if isinstance(victim, _ChunkedAdmit):
+            generated = 0
+            self._admitting = None
+        else:
+            generated = len(victim.emitted)
+            self._slots[victim.slot] = None
+        pages = self._pool.mapped_blocks(victim.slot)
+        self._kv_release(victim.slot, cause="preempted")
+        req.preemptions += 1
+        req.started_at = None
+        self._queue.append(req)  # the priority sort re-orders next pass
+        self._preempts_this_step += 1
+        self.registry.inc("kv_preemptions_total")
+        tier = int(req.priority)
+        # per-tier family (ledger's retrace_reason_* naming convention);
+        # negative tiers spell the sign out — metric names can't hold '-'
+        tier_key = f"neg{-tier}" if tier < 0 else str(tier)
+        self.registry.inc(f"kv_preemptions_tier_{tier_key}_total")
+        self._preempted_by_tier[tier] = self._preempted_by_tier.get(tier, 0) + 1
+        self._update_slot_gauges()
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.preempted", trace_id=req.trace_id, slot=victim.slot,
+                priority=tier, tenant=req.tenant, pages_released=pages,
+                tokens_discarded=generated, beneficiary=beneficiary,
+            )
+        if self._preempts_this_step == 2 and self.flight_recorder is not None:
+            # two victims in ONE scheduling instant = a preemption storm:
+            # the pool is thrashing, not absorbing a single long tail —
+            # incident-worthy once per step (the recorder's cooldown bounds
+            # a sustained storm further)
+            pool = self._pool.stats()
+            self.flight_recorder.trigger(
+                "preemption_storm",
+                f"{self._preempts_this_step} residents preempted in one "
+                f"step: pool {pool['in_use']}/{pool['blocks']} blocks "
+                "in use — sustained memory pressure, not a long tail",
+                trace_ids=[req.trace_id] if req.trace_id else [],
+                blocks=pool["blocks"],
+                blocks_in_use=pool["in_use"],
+            )
+
+    def _preempt_lower_tier(self, head: ServeRequest) -> bool:
+        """Admission-time preemption: a strictly-higher-tier head may
+        evict lower tiers to get in ("interactive preempts batch"). Never
+        fires within a tier — equal-priority admission waits FIFO, so
+        steady same-tier load cannot thrash residents."""
+        victim = self._pick_victim(head.priority, strict=True)
+        if victim is None:
+            return False
+        self._preempt_victim(victim, beneficiary=head.request_id)
+        return True
+
+    def _reclaim_decode_page(self, entry: _Slot) -> str:
+        """A resident crossing a block boundary found the pool dry — make
+        room, cheapest first: LRU-drop an unreferenced cached prefix
+        block, else preempt a victim at or below the resident's own tier,
+        else (every other live request outranks it) the resident YIELDS —
+        preempts itself so higher tiers keep their pages. Returns
+        ``"reclaimed"`` (caller retries the mapping), ``"yielded"`` (the
+        entry is gone; caller skips it), or ``"stuck"`` — structurally
+        unreachable while check_feasible bounds single-request need, kept
+        loud rather than assumed."""
+        index = self._prefix_index
+        while index is not None:
+            freed = index.evict_one(self._pool)
+            if freed is None:
+                break
+            self.registry.inc("kv_prefix_evicted_blocks_total")
+            if freed:
+                self._update_kv_gauges()
+                return "reclaimed"
+        victim = self._pick_victim(
+            entry.req.priority, strict=False, exclude_slot=entry.slot
+        )
+        if victim is not None:
+            self._preempt_victim(victim, beneficiary=entry.req.request_id)
+            return "reclaimed"
+        if self._admitting is not None or len(self._active()) > 1:
+            # every other live request is a higher tier: yield this slot
+            self._preempt_victim(entry, beneficiary=None)
+            return "yielded"
+        # forward-progress guarantee: the LAST resident is never preempted
+        return "stuck"
+
+    def _note_readmitted(self, req: ServeRequest, slot: int) -> None:
+        """Admission-side half of the preempt/replay cycle: count and mark
+        the re-admission of a previously-preempted request so its trace
+        shows the full preempt -> requeue -> readmit arc."""
+        if not req.preemptions:
+            return
+        self.registry.inc("kv_readmissions_total")
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.readmitted", trace_id=req.trace_id, slot=slot,
+                preemptions=req.preemptions,
+            )
+
     # -- slot lifecycle ------------------------------------------------------
     def _update_slot_gauges(self) -> None:
         active = sum(1 for s in self._slots if s is not None)
@@ -1671,11 +1949,16 @@ class SlotServingEngine(ServingEngine):
         # not queue wait
         req.started_at = t0
         self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
+        self._note_readmitted(req, slot)
         if self._pool is not None:
             # the scheduler's admission gate verified capacity; reserve the
-            # worst case and map the prompt's pages (decode steps map the
-            # rest page-by-page as positions fill)
-            self._pool.reserve(slot, int(req.prompt.size) + cfg.max_new_tokens)
+            # worst case (or, under preemption, just the prompt + headroom —
+            # except for a replayed victim, which re-admits pessimistically
+            # so it can never be re-evicted by exhaustion) and map the
+            # prompt's pages (decode steps map the rest page-by-page as
+            # positions fill)
+            self._reserve_admit(slot, int(req.prompt.size), cfg.max_new_tokens,
+                                pessimistic=bool(req.preemptions))
             self._pool.ensure(slot, int(req.prompt.size))
             self._push_table()
             self._update_kv_gauges()
@@ -1751,6 +2034,7 @@ class SlotServingEngine(ServingEngine):
         t0 = self._clock()
         req.started_at = t0
         self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
+        self._note_readmitted(req, slot)
         stage_k = stage_v = None
         if plan is not None:
             # shared path: map the hit's pages (reserve excludes the
@@ -1758,9 +2042,11 @@ class SlotServingEngine(ServingEngine):
             self._map_shared_prefix(req, slot, plan)
             self._update_kv_gauges()
         elif self._pool is not None:
-            # worst-case reservation up front (the admission gate checked
-            # capacity); pages map chunk-by-chunk as the staged prefix grows
-            self._pool.reserve(slot, L + cfg.max_new_tokens)
+            # worst-case (or lazy prompt-sized) reservation up front (the
+            # admission gate checked capacity); pages map chunk-by-chunk as
+            # the staged prefix grows
+            self._reserve_admit(slot, L, cfg.max_new_tokens,
+                                pessimistic=bool(req.preemptions))
             self._update_kv_gauges()
         if plan is None:
             _, cache_s = _prefill_shapes(self.model, self.params)
@@ -2114,6 +2400,15 @@ class SlotServingEngine(ServingEngine):
                             "chunked-prefill fault poisoned the slot state: "
                             f"{type(e).__name__}: {e}"
                         )
+        self._preempts_this_step = 0
+        if self._queue and (
+            self.preemption != "off" or any(r.priority for r in self._queue)
+        ):
+            # priority-ordered admission (stable: request_id keeps FIFO
+            # within a tier, and puts a preempted request's replay back at
+            # its original submission order). Pure-FIFO workloads with the
+            # default tier skip the sort entirely — byte-identical cost.
+            self._queue.sort(key=lambda r: (-r.priority, r.request_id))
         while self._queue:
             slot = self._free_slot()
             if slot is None:
@@ -2164,15 +2459,20 @@ class SlotServingEngine(ServingEngine):
                 # cached prefixes LRU-drop BEFORE the head is made to
                 # wait; each eviction can invalidate the match, so the
                 # plan re-derives until the need is reservable or the
-                # cache is dry.
-                tokens = int(head.prompt.size) + head.config.max_new_tokens
+                # cache is dry. Under optimistic admission the need
+                # shrinks to the head's PROMPT pages + headroom
+                # (_admit_need), and a strictly-higher-tier head may
+                # preempt lower tiers to get in ("interactive preempts
+                # batch") — equal tiers still wait FIFO, so steady
+                # same-tier load cannot thrash residents.
                 while True:
-                    need = self._pool.blocks_needed(tokens) - (
-                        len(plan.nodes) if plan is not None else 0
-                    )
+                    need = self._admit_need(head, plan)
                     if self._pool.can_reserve(need):
                         break
-                    if not self._evict_for(need):
+                    if not self._evict_for(need) and not (
+                        self.preemption != "off"
+                        and self._preempt_lower_tier(head)
+                    ):
                         break
                     try:
                         plan = self._prefix_plan(head.prompt, head.config)
@@ -2241,32 +2541,71 @@ class SlotServingEngine(ServingEngine):
         if not active:
             return disposed
 
-        boundary = any(s.m >= self.model.max_latents for s in active)
         self._rng, key = jax.random.split(self._rng)
         t0 = self._clock()
         try:
             fault = self._chaos.hit("serving.batch") if self._chaos else None
             if fault is not None and fault.kind == "error":
                 raise fault.make_error()
+            if self._pool is not None:
+                # map the page each active row's NEXT write lands on (a
+                # block-boundary crossing maps one fresh block), then
+                # refresh the device table. Reservation makes this
+                # infallible under preemption="off"; under optimistic
+                # admission a dry pool raises PoolExhausted and a victim
+                # yields its pages instead (the boundary-crossing preempt
+                # path; kv.exhaust chaos scripts that pressure
+                # deterministically — consulted once per decode step).
+                forced = None
+                if self._chaos is not None and self.preemption != "off":
+                    forced = self._chaos.hit("kv.exhaust")
+                changed = False
+                for entry in active:
+                    if self._slots[entry.slot] is not entry:
+                        continue  # preempted as an earlier row's victim
+                    next_len = int(entry.req.prompt.size) + len(entry.emitted) + 1
+                    while True:
+                        try:
+                            if forced is not None and forced.kind == "error":
+                                forced = None
+                                raise PoolExhausted(
+                                    "chaos: kv.exhaust scripted pool pressure"
+                                )
+                            changed |= self._pool.ensure(entry.slot, next_len)
+                            # write-routing invariant: COW any still-shared
+                            # page this step's append/migration would write
+                            # through
+                            changed |= self._cow_guard(entry, next_len)
+                            break
+                        except PoolExhausted as e:
+                            if self.preemption == "off":
+                                raise
+                            outcome = self._reclaim_decode_page(entry)
+                            if outcome == "yielded":
+                                break
+                            if outcome == "stuck":
+                                raise RuntimeError(
+                                    "preemption found no victim and no "
+                                    "evictable prefix for the sole "
+                                    "resident — single-request "
+                                    "feasibility was checked at submit: "
+                                    f"{e}"
+                                ) from e
+                if changed:
+                    self._push_table()
+                    self._update_kv_gauges()
+                active = self._active()
+                if not active:
+                    # every resident yielded this step (an all-preempted
+                    # instant): nothing to decode; the requeued replays
+                    # admit next step
+                    return disposed
+            boundary = any(s.m >= self.model.max_latents for s in active)
             executor = self._decode_executor(boundary)
             # armed by a serving_decode_step_ms p95 regression on a PRIOR
             # step: this step (dispatch + host-sync fence) runs under the
             # profiler capture; the step-number read (a registry lock) only
             # happens when a capture actually fires
-            if self._pool is not None:
-                # map the page each active row's NEXT write lands on (a
-                # block-boundary crossing maps one fresh block; reservation
-                # makes this infallible), then refresh the device table
-                changed = False
-                for entry in active:
-                    next_len = int(entry.req.prompt.size) + len(entry.emitted) + 1
-                    changed |= self._pool.ensure(entry.slot, next_len)
-                    # write-routing invariant: COW any still-shared page
-                    # this step's append/migration would write through
-                    changed |= self._cow_guard(entry, next_len)
-                if changed:
-                    self._push_table()
-                    self._update_kv_gauges()
             with self._device_capture(
                 step=lambda: int(self.registry.counter("serving_decode_steps_total"))
             ):
@@ -2565,6 +2904,14 @@ class SlotServingEngine(ServingEngine):
                     counts.get("kv_quant_fallback_total", 0)
                 ),
             }
+            out["preemption"] = {
+                "mode": self.preemption,
+                "admit_headroom_blocks": self.admit_headroom_blocks,
+                "preemptions": int(counts.get("kv_preemptions_total", 0)),
+                "readmissions": int(counts.get("kv_readmissions_total", 0)),
+                "by_tier": dict(sorted(self._preempted_by_tier.items())),
+                "headroom_blocks": self._pool.headroom_blocks,
+            }
             out["prefix_cache"] = {"enabled": self._prefix_index is not None}
             if self._prefix_index is not None:
                 hits = int(counts.get("kv_prefix_hits_total", 0))
@@ -2599,6 +2946,7 @@ class SlotServingEngine(ServingEngine):
         out["admitting"] = self._admitting is not None
         out["kv_layout"] = self.kv_layout
         out["prefix_cache"] = self.prefix_cache
+        out["preemption"] = self.preemption
         out["mesh"] = (
             None if self.sharding is None else self.sharding.describe()
         )

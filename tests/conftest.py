@@ -79,6 +79,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "preemption: optimistic KV admission + preemption test (lazy-page "
+        "reservations with headroom, priority-tier victim selection with "
+        "per-tenant fairness, recompute-from-prompt requeue, kv.exhaust "
+        "chaos zero-leak; serving/kv_pool.py, serving/slots.py; "
+        "docs/serving.md \"Preemption & priorities\"); CPU-fast, runs in "
+        "the tier-1 suite with a per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: SLO telemetry test (per-token latency accounting, burn-rate "
         "monitor, load generator, telemetry-driven fleet admission; "
         "observability/slo.py, observability/loadgen.py; "

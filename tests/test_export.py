@@ -80,7 +80,14 @@ def _mlm_configs(tied=True):
 
 
 def _cases():
-    """(name, reference model, jax config, importer, exporter) per task."""
+    """(name, reference model, jax config, importer, exporter) per task.
+
+    Yields nothing when the reference tree is absent: parametrize evaluates
+    this at *collection* time, before the module-level skipif applies, so
+    dereferencing ``ref`` here would turn a skip into a collection error.
+    """
+    if ref is None:
+        return
     torch.manual_seed(0)
     t_mlm, j_mlm = _mlm_configs()
     yield (
