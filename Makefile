@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident timeline slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -62,6 +62,18 @@ obs-report:
 incident:
 	$(PY) -m pytest tests/test_flight_recorder.py -q -m flight_recorder
 	$(PY) -m perceiver_io_tpu.observability.report --incident tests/fixtures/incident
+
+# scheduler flight-deck suite (docs/observability.md "Scheduler timeline &
+# post-mortems"): timeline ring + JSONL export, timeline<->span join, the
+# exact TTFT/ITL telescoping bar, Chrome-trace schema, preemption
+# post-mortems, per-tenant/per-tier attribution — then the `obs timeline`
+# analyzer over the checked-in fixture (regenerate it with
+# tests/fixtures/timeline/generate.py). CPU-fast, also tier-1.
+timeline:
+	$(PY) -m pytest tests/test_timeline.py -q -m timeline
+	$(PY) -m perceiver_io_tpu.observability.report \
+		--timeline tests/fixtures/timeline/timeline.jsonl \
+		tests/fixtures/timeline/events.jsonl
 
 # SLO telemetry suite (docs/observability.md): burn-rate monitor drills,
 # load-generator determinism, TTFT/ITL accounting, fleet admission

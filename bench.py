@@ -496,7 +496,9 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 dec = _bench_decode(model, state.params, cfg)
                 res.update(extras={**res.data["extras"], "decode": dec})
                 log(f"run: decode cached {dec['cached_tokens_per_sec']} tok/s, "
-                    f"recompute {dec['recompute_tokens_per_sec']} tok/s")
+                    f"recompute {dec['recompute_tokens_per_sec']} tok/s "
+                    f"(latent phase {dec['latent']['speedup']}x, boundary "
+                    f"phase {dec['boundary']['speedup']}x cached-vs-recompute)")
             except Exception as e:
                 log(f"run: decode bench failed ({type(e).__name__}: {e})")
                 res.update(extras={**res.data["extras"], "decode": {
@@ -571,6 +573,13 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                     f"{pmt['strict']['goodput_under_slo']}, "
                     f"{pmt['optimistic']['preemptions']} preemptions, "
                     f"token_identical={pmt['token_identical']})")
+                pm = pmt["optimistic"]["postmortems"]
+                if pm["count"]:
+                    log(f"run: preemption post-mortems {pm['count']} victims, "
+                        f"{pm['tokens_discarded']} tokens replayed, recompute "
+                        f"{pm['recompute_est_ms']}ms vs swap "
+                        f"{pm['swap_est_ms']}ms at {pm['swap_link_gbps']}GB/s "
+                        f"(swap_advantage {pm['swap_advantage_ms']}ms)")
             except Exception as e:
                 log(f"run: preemption A/B failed ({type(e).__name__}: {e})")
                 res.update(extras={**res.data["extras"], "preemption": {
@@ -882,6 +891,66 @@ def _bench_decode(model, params, cfg):
     )
     out.update(batch=b, prompt_len=prompt_len, new_tokens=new_tokens)
     out["boundary_strategy"] = _bench_decode_boundary(model, params, cfg)
+    # per-phase split (the decode_scaling.py pins): the blended probe above
+    # mixes latent-growth and prefix-growth steps, which hides that the
+    # cache's win is phase-dependent — report each phase's tok/s on its own
+    # pin. Boundary numbers come free from the strategy probe (same pin).
+    bs = out["boundary_strategy"]
+    out["boundary"] = {
+        "cached_tokens_per_sec": bs["cached_tokens_per_sec"],
+        "recompute_tokens_per_sec": bs["recompute_tokens_per_sec"],
+        "speedup": round(
+            bs["cached_tokens_per_sec"] / bs["recompute_tokens_per_sec"], 2
+        ),
+        "prompt_len": bs["prompt_len"],
+        "new_tokens": bs["new_tokens"],
+        "start_latents": cfg.max_latents,
+    }
+    out["latent"] = _bench_decode_latent(model, params, cfg)
+    return out
+
+
+def _bench_decode_latent(model, params, cfg, *, new_tokens: int = 8):
+    """Latent-growth phase pin (``examples/perf/decode_scaling.py --phase
+    latent``): latents start ``new_tokens`` below max so every generated
+    token lands in latent growth — the cached step runs O(1) tokens of
+    compute per step while the recompute path pays the full window, the
+    phase where the cache's advantage is largest. Requires ``new_tokens <
+    max_latents`` (clamped). ``params`` arrive bf16-cast from the
+    caller."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference.generate import GenerationConfig, generate
+
+    b = 1
+    new_tokens = max(1, min(
+        new_tokens, cfg.max_latents - 1, cfg.max_seq_len - cfg.max_latents
+    ))
+    prompt_len = cfg.max_seq_len - new_tokens
+    start_latents = cfg.max_latents - new_tokens
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, size=(b, prompt_len), dtype=np.int32)
+    )
+    gcfg = GenerationConfig(max_new_tokens=new_tokens, num_latents=start_latents)
+
+    out = {}
+    for label, use_cache in (("cached", True), ("recompute", False)):
+        ids = generate(model, params, prompt, gcfg, use_cache=use_cache)
+        _fetch(ids[0, -1])  # compile + fence
+        t0 = time.perf_counter()
+        ids = generate(model, params, prompt, gcfg, use_cache=use_cache)
+        _fetch(ids[0, -1])
+        dt = time.perf_counter() - t0
+        out[f"{label}_tokens_per_sec"] = round(b * new_tokens / dt, 1)
+    out["speedup"] = round(
+        out["cached_tokens_per_sec"] / out["recompute_tokens_per_sec"], 2
+    )
+    out.update(
+        prompt_len=prompt_len, new_tokens=new_tokens,
+        start_latents=start_latents,
+    )
     return out
 
 
@@ -1399,6 +1468,11 @@ def _bench_preemption(model, params, cfg, *, budget_slots: int = 3,
             "readmissions": int(pre.get("readmissions", 0)),
             "blocks_high_water": pool["high_water"],
             "admit_waits": pool["admit_waits"],
+            # recompute-vs-swap post-mortem model (ISSUE 18): what each
+            # eviction cost in replayed decode steps vs what a host-swap of
+            # the victim's pages would have cost at swap_link_gbps — the
+            # number that decides whether a swap tier is worth building
+            "postmortems": engine.postmortems(),
         }
 
     return {

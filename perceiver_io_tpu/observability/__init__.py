@@ -75,6 +75,13 @@ from perceiver_io_tpu.observability.slo import (
     goodput_ratio,
     offered_load,
 )
+from perceiver_io_tpu.observability.timeline import (
+    StepTimeline,
+    TimelineArgs,
+    read_timeline_jsonl,
+    tenant_label,
+    tier_label,
+)
 from perceiver_io_tpu.observability.tracing import (
     JsonlSpanSink,
     SamplingSpanSink,
@@ -133,6 +140,14 @@ class ObservabilityArgs:
     #: autoscaler escalation, gateway mass-disconnect), each a bounded
     #: atomic spans+state capture the ``obs incident`` analyzer reads.
     incident: IncidentArgs = dataclasses.field(default_factory=IncidentArgs)
+    #: the ``--obs.timeline.*`` sub-group: the scheduler step timeline
+    #: (docs/observability.md "Scheduler timeline & post-mortems").
+    #: Setting ``timeline.steps`` attaches a bounded :class:`StepTimeline`
+    #: ring to every serve-run engine — one structured record per
+    #: scheduler pass (admissions, chunk progress, retirements,
+    #: preemptions, occupancy, per-phase wall ms) the ``obs timeline``
+    #: analyzer renders as a Gantt view / Chrome-trace JSON.
+    timeline: TimelineArgs = dataclasses.field(default_factory=TimelineArgs)
 
 
 __all__ = [
@@ -157,6 +172,8 @@ __all__ = [
     "SamplingSpanSink",
     "SnapshotWriter",
     "Span",
+    "StepTimeline",
+    "TimelineArgs",
     "Tracer",
     "WorkloadSpec",
     "default_ledger",
@@ -167,6 +184,9 @@ __all__ = [
     "offered_load",
     "read_events_jsonl",
     "read_metrics_jsonl",
+    "read_timeline_jsonl",
     "snapshot_json",
+    "tenant_label",
+    "tier_label",
     "to_prometheus_text",
 ]

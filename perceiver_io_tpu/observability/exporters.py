@@ -160,6 +160,14 @@ HELP_TEXT = {
     "incident_bundles_total": "Incident bundles written to disk by the flight recorder.",
     "incident_suppressed_total": "Triggers suppressed by per-kind cooldown or the max-bundles budget.",
     "incident_dump_errors_total": "Incident bundle dumps that failed (capture must never compound the incident).",
+    "timeline_steps_total": "Scheduler passes recorded into the step timeline ring (docs/observability.md \"Scheduler timeline & post-mortems\").",
+    "timeline_records_dropped_total": "Step-timeline records evicted past the ring capacity (--obs.timeline.steps).",
+    "timeline_ring_records": "Step-timeline records currently retained in the ring.",
+    # the always-published members of the per-tier / per-tenant attribution
+    # families get direct entries (the *_has_direct_help satellite bar);
+    # other labels resolve through _HELP_PREFIXES below
+    "serving_tokens_tier_0_total": "Real tokens generated for requests at the default priority tier 0 (per-tier cost attribution).",
+    "kv_pool_tenant_blocks_in_use_default": "Pool blocks currently mapped for untagged (no-tenant) resident requests (per-tenant cost attribution).",
 }
 
 #: prefix-matched fallbacks for generated families (per-reason counters,
@@ -168,6 +176,9 @@ _HELP_PREFIXES = (
     ("retrace_reason_", "Retraces attributed to this changed cache-key component."),
     ("slo_burn_rate_", "Per-dimension SLO burn rate over one window (bad fraction / error budget)."),
     ("slo_breach_", "SLO breaches entered on this dimension."),
+    ("kv_preemptions_tier_", "Preemptions whose victim held this priority tier (neg<k> spells a negative tier)."),
+    ("kv_pool_tenant_blocks_in_use_", "Pool blocks currently mapped for this tenant's resident requests (per-tenant cost attribution)."),
+    ("serving_tokens_tier_", "Real tokens generated for requests at this priority tier (per-tier cost attribution)."),
 )
 
 

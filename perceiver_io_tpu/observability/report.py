@@ -50,6 +50,18 @@ wait, prefill (chunked admissions included), and the first decode step,
 telescoping EXACTLY to the request's recorded ``serving_ttft_ms`` — with
 the worst request pinned against the registry's nearest-rank percentiles
 like every other section.
+
+**`obs timeline`** (docs/observability.md "Scheduler timeline &
+post-mortems") is the third analyzer: point it at a
+:class:`~perceiver_io_tpu.observability.StepTimeline` JSONL export
+(``--obs.timeline.export``) and it renders the scheduler flight deck — a
+per-slot Gantt text view of admissions / prefill chunks / tokens /
+retirements / preemptions, per-pass phase percentiles, disposition
+accounting, and a per-request ``ttft + Σ itl`` decomposition that
+telescopes exactly to the terminal span durations (0.0 unattributed on a
+FakeClock run, same bar as the incident TTFT split). ``--trace-out``
+additionally emits Chrome-trace JSON built from the ring AND the span
+events — load it in Perfetto / ``chrome://tracing``.
 """
 from __future__ import annotations
 
@@ -58,6 +70,7 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from perceiver_io_tpu.observability.registry import Histogram
+from perceiver_io_tpu.observability.timeline import read_timeline_jsonl
 from perceiver_io_tpu.observability.tracing import (
     TAIL_KEEP_STATUSES,
     read_events_jsonl,
@@ -1418,6 +1431,514 @@ def run(events_path: str, snapshot_path: Optional[str] = None, *,
     return format_report(analysis, top=top)
 
 
+# ===========================================================================
+# `obs timeline` — the scheduler flight deck (docs/observability.md
+# "Scheduler timeline & post-mortems"): render a StepTimeline export as a
+# per-slot Gantt text view + per-request phase decomposition, and/or emit
+# Chrome-trace JSON (load in Perfetto / chrome://tracing) built from the
+# ring and the span events together.
+# ===========================================================================
+
+#: Gantt cell glyphs, highest display priority first — a pass where a slot
+#: was both decoded and preempted shows the preemption.
+_GANTT_PRIORITY = "Xrap#=."
+_GANTT_LEGEND = (
+    "X=preempted  r=retired  a=admitted  p=prefill chunk  "
+    "#=token  ==resident (no token)  .=idle"
+)
+
+
+def load_timeline(path: str) -> List[dict]:
+    """Read a ``--obs.timeline.export`` JSONL back (schema-checked)."""
+    return read_timeline_jsonl(path)
+
+
+def _terminal_spans_by_request(events: List[dict]) -> Dict[int, dict]:
+    """``request_id -> terminal serving.request row`` — the join key between
+    ring records (request_id) and the span stream (trace_id)."""
+    out: Dict[int, dict] = {}
+    for row in events:
+        if row.get("span") != "serving.request":
+            continue
+        rid = (row.get("attrs") or {}).get("request_id")
+        if rid is not None:
+            out[int(rid)] = row
+    return out
+
+
+def _timeline_requests(records: List[dict],
+                       events: List[dict]) -> List[dict]:
+    """Per-request phase decomposition from the ring's token entries, worst
+    first. Token entries carry the SAME rounded ``ttft_ms`` / ``itl_ms``
+    values the registry observed, so ``ttft_ms + decode_ms`` telescopes
+    exactly to the terminal ``serving.request`` span's duration
+    (``unattributed_ms`` == 0.0 on a FakeClock run — the
+    :func:`ttft_decomposition` exactness bar).
+
+    A preemption replay re-anchors nothing: the replayed first token's
+    ``ttft_ms`` still reaches back to the ORIGINAL anchor, so the segment
+    from the LAST ``first=True`` entry onward covers the request end to end
+    (earlier entries are the discarded replay — surfaced as
+    ``replayed_tokens``). ``unattributed_ms`` goes negative exactly when a
+    front door (fleet/gateway) anchored TTFT before the engine submit —
+    that share lives outside the engine-side terminal span."""
+    toks: Dict[int, List[dict]] = {}
+    order: List[int] = []
+    for rec in records:
+        for e in rec.get("tokens") or []:
+            rid = e.get("request_id")
+            if rid is None:
+                continue
+            rid = int(rid)
+            if rid not in toks:
+                order.append(rid)
+                toks[rid] = []
+            toks[rid].append(e)
+    terminals = _terminal_spans_by_request(events)
+    rows: List[dict] = []
+    for rid in order:
+        entries = toks[rid]
+        seg_start, attempts = 0, 0
+        for i, e in enumerate(entries):
+            if e.get("first"):
+                attempts += 1
+                seg_start = i
+        seg = entries[seg_start:]
+        ttft = seg[0].get("ttft_ms") if seg and seg[0].get("first") else None
+        decode = round(
+            sum(float(e.get("itl_ms") or 0.0) for e in seg[1:]), 3
+        )
+        row: dict = {
+            "request_id": rid,
+            "tokens": len(seg),
+            "replayed_tokens": len(entries) - len(seg),
+            "attempts": attempts,
+            "ttft_ms": ttft,
+            "decode_ms": decode,
+        }
+        if ttft is not None:
+            row["total_ms"] = round(float(ttft) + decode, 3)
+        term = terminals.get(rid)
+        if term is not None:
+            row["status"] = term.get("status")
+            row["trace_id"] = term.get("trace_id")
+            dur = term.get("duration_ms")
+            if isinstance(dur, (int, float)) and ttft is not None:
+                row["span_ms"] = round(float(dur), 3)
+                row["unattributed_ms"] = round(
+                    float(dur) - float(ttft) - decode, 3
+                )
+        rows.append(row)
+    rows.sort(key=lambda r: -(r.get("total_ms") or -1.0))
+    return rows
+
+
+def analyze_timeline(records: List[dict],
+                     events: Optional[List[dict]] = None,
+                     snapshot: Optional[dict] = None) -> dict:
+    """Pure analysis over StepTimeline records (+ optional span events for
+    the request join, + optional registry snapshot for the accounting
+    closure); returns the JSON-able body ``format_timeline`` renders."""
+    events = events or []
+    snapshot = snapshot or {}
+    phase_vals: Dict[str, List[float]] = {}
+    occ_busy = occ_total = 0
+    rows_real = rows_padded = 0
+    kinds: Dict[str, int] = {}
+    by_status: Dict[str, int] = {}
+    queue_depths: List[int] = []
+    for rec in records:
+        for key, val in (rec.get("phases_ms") or {}).items():
+            phase_vals.setdefault(key, []).append(float(val))
+        slots = rec.get("slots")
+        if isinstance(slots, list):
+            occ_busy += sum(1 for s in slots if s is not None)
+            occ_total += len(slots)
+        rows = rec.get("rows") or {}
+        rows_real += int(rows.get("real", 0))
+        rows_padded += int(rows.get("padded", 0))
+        qd = rec.get("queue_depth")
+        if isinstance(qd, int):
+            queue_depths.append(qd)
+        for kind in ("admitted", "chunks", "tokens", "finished",
+                     "preempted", "readmitted"):
+            entries = rec.get(kind) or []
+            if entries:
+                kinds[kind] = kinds.get(kind, 0) + len(entries)
+        for e in rec.get("finished") or []:
+            status = e.get("status", "?")
+            by_status[status] = by_status.get(status, 0) + 1
+    # disposition closure over the retained ring: every admission is either
+    # still resident, finished, or was preempted back to the queue (each
+    # readmission re-admits, so preempted - readmitted nets the requeued)
+    accounting = {
+        "admitted": kinds.get("admitted", 0),
+        "finished": sum(by_status.values()),
+        "finished_by_status": dict(sorted(by_status.items())),
+        "preempted": kinds.get("preempted", 0),
+        "readmitted": kinds.get("readmitted", 0),
+    }
+    counters = snapshot.get("counters") or {}
+    if counters:
+        accounting["registry"] = {
+            name: int(counters.get(f"serving_requests_{name}_total", 0))
+            for name in ("completed", "cancelled", "timed_out", "failed")
+        }
+    last = records[-1] if records else None
+    return {
+        "meta": {
+            "records": len(records),
+            "steps": (
+                None if not records
+                else [records[0].get("step"), records[-1].get("step")]
+            ),
+            "engines": sorted(
+                {str(r.get("engine", "?")) for r in records}
+            ),
+        },
+        "phases": {
+            k: _percentiles(v) for k, v in sorted(phase_vals.items())
+        },
+        "occupancy": {
+            "slot_steps_busy": occ_busy,
+            "slot_steps_total": occ_total,
+            "fraction": (
+                round(occ_busy / occ_total, 4) if occ_total else None
+            ),
+            "queue_depth_max": max(queue_depths, default=0),
+        },
+        "rows": {
+            "real": rows_real,
+            "padded": rows_padded,
+            "padding_waste": (
+                round(rows_padded / (rows_real + rows_padded), 4)
+                if rows_real + rows_padded else None
+            ),
+        },
+        "events": dict(sorted(kinds.items())),
+        "accounting": accounting,
+        "pool": (last or {}).get("pool"),
+        "tenants": (last or {}).get("tenants"),
+        "requests": _timeline_requests(records, events),
+    }
+
+
+def timeline_gantt(records: List[dict], *, width: int = 96) -> List[str]:
+    """Per-slot Gantt over the most recent ``width`` passes: one text row
+    per slot, one character per pass (legend: ``_GANTT_LEGEND``; a cell
+    takes the highest-priority event that touched it). Bucket-engine rings
+    (no occupancy vector) collapse to a single ``batch`` row."""
+    slotted = [r for r in records if isinstance(r.get("slots"), list)]
+    recs = (slotted or records)[-width:]
+    if not recs:
+        return ["(no records)"]
+    nslots = (
+        max(len(r["slots"]) for r in slotted[-width:]) if slotted else 1
+    )
+    prio = {ch: i for i, ch in enumerate(reversed(_GANTT_PRIORITY))}
+    grid = [["."] * len(recs) for _ in range(nslots)]
+
+    def mark(slot, col, ch):
+        if slot is None or not 0 <= slot < nslots:
+            return
+        if prio[ch] > prio[grid[slot][col]]:
+            grid[slot][col] = ch
+
+    prev_slots: List = []
+    for col, rec in enumerate(recs):
+        slots = rec.get("slots") if isinstance(rec.get("slots"), list) else []
+        # request -> slot map for slot-less `finished` entries: this pass's
+        # slot-carrying events first, then residency (a retiring request
+        # left the occupancy vector before the record was cut)
+        rid2slot: Dict[int, int] = {}
+        for kind in ("tokens", "chunks", "admitted", "preempted"):
+            for e in rec.get(kind) or []:
+                if e.get("request_id") is not None and e.get("slot") is not None:
+                    rid2slot.setdefault(int(e["request_id"]), int(e["slot"]))
+        for occ in (slots, prev_slots):
+            for i, rid in enumerate(occ):
+                if rid is not None:
+                    rid2slot.setdefault(int(rid), i)
+        for i, rid in enumerate(slots):
+            if rid is not None:
+                mark(i, col, "=")
+        if not slots:  # bucket engine: everything lands on the one row
+            if rec.get("tokens"):
+                mark(0, col, "#")
+            if rec.get("admitted"):
+                mark(0, col, "a")
+            if rec.get("finished"):
+                mark(0, col, "r")
+        for e in rec.get("tokens") or []:
+            mark(e.get("slot"), col, "#")
+        for e in rec.get("chunks") or []:
+            mark(e.get("slot"), col, "p")
+        for e in rec.get("admitted") or []:
+            mark(e.get("slot"), col, "a")
+        for e in rec.get("finished") or []:
+            rid = e.get("request_id")
+            if rid is not None:
+                mark(rid2slot.get(int(rid)), col, "r")
+        for e in rec.get("preempted") or []:
+            mark(e.get("slot"), col, "X")
+        prev_slots = slots
+    first_step = recs[0].get("step")
+    last_step = recs[-1].get("step")
+    out = [f"steps {first_step}..{last_step} (one column per pass)"]
+    label = "batch" if not slotted else "slot"
+    for i, row in enumerate(grid):
+        name = label if not slotted else f"{label} {i}"
+        out.append(f"  {name:<8}|{''.join(row)}|")
+    out.append(f"  {_GANTT_LEGEND}")
+    return out
+
+
+def chrome_trace(records: List[dict],
+                 events: Optional[List[dict]] = None) -> dict:
+    """Chrome trace-event JSON (the ``chrome://tracing`` / Perfetto load
+    format) from the ring + span events: pid 1 holds the scheduler lane
+    (one complete ``X`` event per pass, phases/pool in ``args``) and one
+    lane per slot (contiguous residency runs as ``X``, lifecycle moments as
+    ``i`` instants); pid 2 holds the request spans from events.jsonl, one
+    lane per trace. Timestamps are microseconds on the engine clock, per
+    the trace-event schema."""
+    trace_events: List[dict] = []
+
+    def us(t: float) -> float:
+        return round(float(t) * 1e6, 3)
+
+    trace_events.append({
+        "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+        "args": {"name": "scheduler timeline"},
+    })
+    trace_events.append({
+        "ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+        "args": {"name": "scheduler"},
+    })
+    nslots = max(
+        (len(r["slots"]) for r in records
+         if isinstance(r.get("slots"), list)),
+        default=0,
+    )
+    for s in range(nslots):
+        trace_events.append({
+            "ph": "M", "pid": 1, "tid": s + 1, "name": "thread_name",
+            "args": {"name": f"slot {s}"},
+        })
+    # residency runs: (slot, request_id, start_s) while the occupant holds
+    runs: Dict[int, Tuple[int, float]] = {}
+    for rec in records:
+        t0, t1 = rec.get("t_start_s"), rec.get("t_end_s")
+        if not isinstance(t0, (int, float)) or not isinstance(t1, (int, float)):
+            continue
+        args = {
+            "step": rec.get("step"),
+            "queue_depth": rec.get("queue_depth"),
+            "phases_ms": rec.get("phases_ms"),
+        }
+        for key in ("pool", "rows", "tenants"):
+            if rec.get(key) is not None:
+                args[key] = rec[key]
+        trace_events.append({
+            "ph": "X", "pid": 1, "tid": 0, "cat": "scheduler",
+            "name": f"step {rec.get('step')}",
+            "ts": us(t0), "dur": max(us(t1) - us(t0), 0.0), "args": args,
+        })
+        for kind, label in (("admitted", "admit"), ("preempted", "preempt"),
+                            ("readmitted", "readmit"), ("finished", "finish")):
+            for e in rec.get(kind) or []:
+                slot = e.get("slot")
+                trace_events.append({
+                    "ph": "i", "pid": 1, "s": "t", "cat": "lifecycle",
+                    "tid": slot + 1 if isinstance(slot, int) else 0,
+                    "ts": us(t1),
+                    "name": f"{label} req {e.get('request_id')}",
+                    "args": dict(e),
+                })
+        slots = rec.get("slots")
+        if isinstance(slots, list):
+            for i, rid in enumerate(slots):
+                open_run = runs.get(i)
+                if open_run is not None and (rid is None or int(rid) != open_run[0]):
+                    trace_events.append({
+                        "ph": "X", "pid": 1, "tid": i + 1, "cat": "residency",
+                        "name": f"req {open_run[0]}", "ts": us(open_run[1]),
+                        "dur": max(us(t0) - us(open_run[1]), 0.0),
+                        "args": {"request_id": open_run[0]},
+                    })
+                    runs.pop(i)
+                if rid is not None and i not in runs:
+                    runs[i] = (int(rid), float(t0))
+    if records and runs:
+        t_last = records[-1].get("t_end_s") or 0.0
+        for i, (rid, start) in sorted(runs.items()):
+            trace_events.append({
+                "ph": "X", "pid": 1, "tid": i + 1, "cat": "residency",
+                "name": f"req {rid}", "ts": us(start),
+                "dur": max(us(t_last) - us(start), 0.0),
+                "args": {"request_id": rid},
+            })
+    if events:
+        trace_events.append({
+            "ph": "M", "pid": 2, "tid": 0, "name": "process_name",
+            "args": {"name": "request spans"},
+        })
+        lanes: Dict[str, int] = {}
+        for row in events:
+            dur = row.get("duration_ms")
+            t0 = row.get("start_s")
+            if not isinstance(dur, (int, float)) or not isinstance(t0, (int, float)):
+                continue
+            trace_id = str(row.get("trace_id") or "?")
+            tid = lanes.get(trace_id)
+            if tid is None:
+                tid = lanes[trace_id] = len(lanes) + 1
+                trace_events.append({
+                    "ph": "M", "pid": 2, "tid": tid, "name": "thread_name",
+                    "args": {"name": trace_id},
+                })
+            args = {"trace_id": trace_id, "status": row.get("status")}
+            if row.get("attrs"):
+                args.update(row["attrs"])
+            trace_events.append({
+                "ph": "X", "pid": 2, "tid": tid, "cat": "span",
+                "name": str(row.get("span", "?")),
+                "ts": us(t0), "dur": round(float(dur) * 1e3, 3),
+                "args": args,
+            })
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"schema": "step-timeline-v1"},
+    }
+
+
+def format_timeline(analysis: dict, records: List[dict], *,
+                    top: int = 20, width: int = 96) -> str:
+    """Human-readable flight-deck rendering of :func:`analyze_timeline`."""
+    out: List[str] = []
+    meta = analysis["meta"]
+    out.append("== scheduler timeline ==")
+    steps = meta["steps"]
+    out.append(
+        f"records: {meta['records']}"
+        + (f"  steps {steps[0]}..{steps[1]}" if steps else "")
+        + f"  engines: {', '.join(meta['engines']) or '-'}"
+    )
+    occ = analysis["occupancy"]
+    if occ["slot_steps_total"]:
+        out.append(
+            f"occupancy: {occ['slot_steps_busy']}/{occ['slot_steps_total']} "
+            f"slot-steps busy ({occ['fraction']})  "
+            f"queue depth max: {occ['queue_depth_max']}"
+        )
+    rows = analysis["rows"]
+    if rows["real"] or rows["padded"]:
+        out.append(
+            f"decode rows: real={rows['real']} padded={rows['padded']} "
+            f"(waste {rows['padding_waste']})"
+        )
+
+    out.append("")
+    out.append("== per-pass phases (ms) ==")
+    if analysis["phases"]:
+        out.append(
+            f"{'phase':<12}{'count':>8}{'total_ms':>12}{'p50_ms':>10}"
+            f"{'p95_ms':>10}{'max_ms':>10}"
+        )
+        for name, p in analysis["phases"].items():
+            out.append(
+                f"{name:<12}{p['count']:>8}{_fmt(p['total_ms'], 12)}"
+                f"{_fmt(p['p50_ms'])}{_fmt(p['p95_ms'])}{_fmt(p['max_ms'])}"
+            )
+    else:
+        out.append("(no phase marks in ring)")
+
+    acct = analysis["accounting"]
+    out.append("")
+    out.append("== accounting ==")
+    out.append(
+        f"admitted={acct['admitted']}  finished={acct['finished']} "
+        + (
+            "("
+            + ", ".join(
+                f"{k}={v}" for k, v in acct["finished_by_status"].items()
+            )
+            + ")  " if acct["finished_by_status"] else " "
+        )
+        + f"preempted={acct['preempted']}  readmitted={acct['readmitted']}"
+    )
+    if acct.get("registry"):
+        out.append(
+            "registry: "
+            + "  ".join(f"{k}={v}" for k, v in acct["registry"].items())
+        )
+    if analysis.get("pool"):
+        pool = analysis["pool"]
+        out.append(
+            f"pool (last pass): in_use={pool.get('in_use')} "
+            f"reserved={pool.get('reserved')} headroom={pool.get('headroom')}"
+        )
+    if analysis.get("tenants"):
+        out.append(
+            "tenant pages (last pass): "
+            + ", ".join(
+                f"{k}={v}" for k, v in analysis["tenants"].items()
+            )
+        )
+
+    reqs = analysis["requests"]
+    out.append("")
+    out.append("== per-request decomposition (worst first) ==")
+    if reqs:
+        out.append(
+            f"{'request':>8}{'status':>11}{'tok':>5}{'replay':>7}"
+            f"{'ttft_ms':>10}{'decode_ms':>11}{'total_ms':>10}"
+            f"{'span_ms':>10}{'unattr_ms':>10}"
+        )
+        for row in reqs[:top]:
+            out.append(
+                f"{row['request_id']:>8}{str(row.get('status') or '-'):>11}"
+                f"{row['tokens']:>5}{row['replayed_tokens']:>7}"
+                f"{_fmt(row.get('ttft_ms'))}{_fmt(row.get('decode_ms'), 11)}"
+                f"{_fmt(row.get('total_ms'))}{_fmt(row.get('span_ms'))}"
+                f"{_fmt(row.get('unattributed_ms'))}"
+            )
+        if len(reqs) > top:
+            out.append(f"  ... {len(reqs) - top} more")
+    else:
+        out.append("(no token events in ring)")
+
+    out.append("")
+    out.append("== slot gantt ==")
+    out.extend(timeline_gantt(records, width=width))
+    return "\n".join(out)
+
+
+def run_timeline(timeline_path: str, events_path: Optional[str] = None,
+                 snapshot_path: Optional[str] = None, *,
+                 trace_out: Optional[str] = None, top: int = 20,
+                 as_json: bool = False) -> str:
+    """Load a timeline export (+ optional events/snapshot), analyze, and
+    return the rendered flight deck; ``trace_out`` additionally writes the
+    Chrome-trace JSON next to it."""
+    records = load_timeline(timeline_path)
+    events = read_events_jsonl(events_path) if events_path else []
+    snapshot = None
+    if snapshot_path:
+        with open(snapshot_path) as fh:
+            snapshot = json.load(fh)
+    analysis = analyze_timeline(records, events, snapshot)
+    extra = ""
+    if trace_out:
+        with open(trace_out, "w", encoding="utf-8") as fh:
+            json.dump(chrome_trace(records, events), fh, sort_keys=True)
+        extra = f"\n\nchrome trace: {trace_out} (load in Perfetto)"
+    if as_json:
+        return json.dumps(analysis, indent=2, sort_keys=True)
+    return format_timeline(analysis, records, top=top) + extra
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -1436,6 +1957,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="incident bundle directory (or its "
                              "manifest.json) — renders the incident report "
                              "instead of the events report")
+    parser.add_argument("--timeline", default=None,
+                        help="StepTimeline JSONL export "
+                             "(--obs.timeline.export) — renders the "
+                             "scheduler flight deck instead of the events "
+                             "report (the events positional becomes the "
+                             "optional span join input)")
+    parser.add_argument("--trace-out", default=None,
+                        help="with --timeline: also write Chrome-trace "
+                             "JSON here (load in Perfetto / "
+                             "chrome://tracing)")
     parser.add_argument("--top", type=int, default=20,
                         help="rows shown in the compile table (report) / "
                              "decomposition (incident)")
@@ -1445,8 +1976,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.incident is not None:
             print(run_incident(args.incident, top=args.top, as_json=args.json))
+        elif args.timeline is not None:
+            print(run_timeline(
+                args.timeline, args.events, args.snapshot,
+                trace_out=args.trace_out, top=args.top, as_json=args.json,
+            ))
         elif args.events is None:
-            parser.error("an events.jsonl path (or --incident) is required")
+            parser.error(
+                "an events.jsonl path (or --incident / --timeline) is "
+                "required"
+            )
         else:
             print(run(args.events, args.snapshot, top=args.top,
                       as_json=args.json))
@@ -1455,7 +1994,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except json.JSONDecodeError as e:
         raise SystemExit(
             f"obs report: artifact is not valid JSON "
-            f"({args.incident or args.snapshot or args.events}: {e})"
+            f"({args.incident or args.timeline or args.snapshot or args.events}: {e})"
         )
     except (OSError, ValueError) as e:
         raise SystemExit(f"obs report: {e}")

@@ -572,6 +572,31 @@ def _obs_kit(obs, root: str, *, is_main: bool = True,
             "--obs.incident.* tunes the incident flight recorder, which is "
             "enabled by setting --obs.incident.dir (docs/observability.md)"
         )
+    timeline = None
+    timeline_export = None
+    if obs.timeline.enabled:
+        if obs.timeline.swap_gbps <= 0:
+            raise SystemExit(
+                f"--obs.timeline.swap_gbps must be > 0, got "
+                f"{obs.timeline.swap_gbps}"
+            )
+        if is_main:
+            from perceiver_io_tpu.observability import StepTimeline
+
+            # the scheduler step timeline (docs/observability.md "Scheduler
+            # timeline & post-mortems"): run_serve attaches this ring to
+            # every engine it builds; the export lands at serve end
+            timeline = StepTimeline(cap=obs.timeline.steps, registry=registry)
+            if obs.timeline.export is not None:
+                timeline_export = _resolve(obs.timeline.export)
+    elif obs.timeline != type(obs.timeline)() or any(
+        k.startswith("obs.timeline.") for k in (passed or ())
+    ):
+        # inapplicable-flag convention, same as --obs.incident.*
+        raise SystemExit(
+            "--obs.timeline.* tunes the scheduler step timeline, which is "
+            "enabled by setting --obs.timeline.steps (docs/observability.md)"
+        )
     trigger = None
     if obs.profile_on_regress_factor is not None and is_main:
         if jax.process_count() > 1:
@@ -601,6 +626,8 @@ def _obs_kit(obs, root: str, *, is_main: bool = True,
         "trigger": trigger,
         "slo_monitor": slo_monitor,
         "flight_recorder": flight_recorder,
+        "timeline": timeline,
+        "timeline_export": timeline_export,
     }
 
 
@@ -716,12 +743,17 @@ class CLI:
             # `obs report` reads the artifacts a run left behind, `obs
             # incident` reads one flight-recorder bundle
             # (docs/observability.md)
-            if len(argv) < 2 or argv[1] not in ("report", "incident"):
+            if len(argv) < 2 or argv[1] not in (
+                "report", "incident", "timeline"
+            ):
                 raise SystemExit(
                     "usage: obs report --events <events.jsonl> "
                     "[--snapshot <snapshot.json>] [--top N] [--json true]\n"
                     "       obs incident --bundle <incident dir> "
-                    "[--top N] [--json true]"
+                    "[--top N] [--json true]\n"
+                    "       obs timeline --timeline <timeline.jsonl> "
+                    "[--events <events.jsonl>] [--snapshot <snapshot.json>] "
+                    "[--trace_out <trace.json>] [--top N] [--json true]"
                 )
             import json as _json
 
@@ -748,6 +780,36 @@ class CLI:
                     )
                 except (OSError, ValueError) as e:
                     raise SystemExit(f"obs incident: {e}")
+                print(text)
+                return text
+            if argv[1] == "timeline":
+                known = {
+                    "timeline": str, "events": str, "snapshot": str,
+                    "trace_out": str, "top": int, "json": bool,
+                }
+                vals = _parse_dotted(argv[2:], known)
+                if "timeline" not in vals:
+                    raise SystemExit(
+                        "obs timeline requires --timeline <timeline.jsonl> "
+                        "(a --obs.timeline.export file)"
+                    )
+                try:
+                    text = report_mod.run_timeline(
+                        vals["timeline"], vals.get("events"),
+                        vals.get("snapshot"),
+                        trace_out=vals.get("trace_out"),
+                        top=int(vals.get("top", 20)),
+                        as_json=bool(vals.get("json", False)),
+                    )
+                # JSONDecodeError IS a ValueError — catch it first, with
+                # the artifact path the generic message would drop
+                except _json.JSONDecodeError as e:
+                    raise SystemExit(
+                        f"obs timeline: artifact is not valid JSON "
+                        f"({vals.get('timeline')}: {e})"
+                    )
+                except (OSError, ValueError) as e:
+                    raise SystemExit(f"obs timeline: {e}")
                 print(text)
                 return text
             known = {"events": str, "snapshot": str, "top": int, "json": bool}
@@ -827,6 +889,14 @@ class CLI:
             raise SystemExit(
                 "--obs.slo.* applies to the serve subcommand (SLO targets "
                 "monitor serving token latency; docs/observability.md)"
+            )
+        if any(k.startswith("obs.timeline.") for k in values):
+            # same stance: the step timeline records SCHEDULER passes —
+            # only the serve engines have one
+            raise SystemExit(
+                "--obs.timeline.* applies to the serve subcommand (the "
+                "step timeline records scheduler passes; "
+                "docs/observability.md)"
             )
         data_kwargs = {
             k.split(".", 1)[1]: v for k, v in values.items() if k.startswith("data.")
@@ -1202,12 +1272,16 @@ class CLI:
                             mesh_alloc.acquire() if mesh_alloc is not None
                             else None
                         ),
+                        swap_link_gbps=obs.timeline.swap_gbps,
                         **engine_kwargs
                     )
                     # inside the factory, not after it: fleet replica
                     # restarts / autoscaler spawns rebuild engines through
                     # this factory and must keep the pool-exhaustion seam
                     eng.flight_recorder = flight_recorder
+                    # shared ring: every replica's passes land in ONE
+                    # step-ordered timeline (--obs.timeline.steps)
+                    eng.timeline = kit["timeline"]
                     return eng
             else:
                 if args.prefill_chunk is not None:
@@ -1248,6 +1322,7 @@ class CLI:
                         model, params, gen_cfg, table, **engine_kwargs
                     )
                     eng.flight_recorder = flight_recorder
+                    eng.timeline = kit["timeline"]
                     return eng
             if fleet_mode:
                 from perceiver_io_tpu.serving import FleetRouter
@@ -1322,6 +1397,29 @@ class CLI:
                     flight_recorder.add_source("kv_pool", _fleet_pools)
                 elif getattr(engine, "_pool", None) is not None:
                     flight_recorder.add_source("kv_pool", engine._pool.stats)
+                if kit["timeline"] is not None:
+                    # ring summary lands in every bundle; the full records
+                    # live in the --obs.timeline.export JSONL
+                    flight_recorder.add_source(
+                        "timeline", kit["timeline"].summary
+                    )
+                # per-victim recompute-vs-swap post-mortems (docs/
+                # observability.md "Scheduler timeline & post-mortems")
+                if fleet_mode:
+                    def _fleet_postmortems():
+                        return {
+                            str(r.replica_id): r.engine.postmortems()
+                            for r in engine.replicas
+                            if hasattr(r.engine, "postmortems")
+                        }
+
+                    flight_recorder.add_source(
+                        "preemption_postmortems", _fleet_postmortems
+                    )
+                elif hasattr(engine, "postmortems"):
+                    flight_recorder.add_source(
+                        "preemption_postmortems", engine.postmortems
+                    )
             if args.warmup:
                 t0 = time.monotonic()
                 compiles = engine.warmup()
@@ -1363,6 +1461,15 @@ class CLI:
             ledger.update_device_gauges()
             if kit["snapshot_writer"] is not None:
                 kit["snapshot_writer"].maybe_write(force=True)
+            if kit["timeline_export"] is not None and kit["timeline"] is not None:
+                # same stance as the snapshot: an exception mid-drain still
+                # leaves the ring on disk for `obs timeline`
+                n = kit["timeline"].write_jsonl(kit["timeline_export"])
+                print(
+                    f"[serve] timeline: wrote {n} step records to "
+                    f"{kit['timeline_export']}",
+                    file=sys.stderr, flush=True,
+                )
             if kit["sink"] is not None:
                 kit["sink"].close()
 
@@ -1434,6 +1541,11 @@ class CLI:
                 stats["slo"] = kit["slo_monitor"].stats()
             if kit["flight_recorder"] is not None:
                 stats["incident"] = kit["flight_recorder"].stats()
+            if kit["timeline"] is not None and "timeline" not in stats:
+                # fleet stats() has no ring of its own; the shared ring's
+                # summary rides the run record (single-engine stats()
+                # already embeds it)
+                stats["timeline"] = kit["timeline"].summary()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return []
 
@@ -1550,6 +1662,8 @@ class CLI:
             if kit["flight_recorder"] is not None:
                 # the run's one durable record names every bundle written
                 stats["incident"] = kit["flight_recorder"].stats()
+            if kit["timeline"] is not None and "timeline" not in stats:
+                stats["timeline"] = kit["timeline"].summary()
             print(json.dumps({"serve_stats": stats}), flush=True)
         return results
 
@@ -1597,8 +1711,15 @@ class CLI:
               "--obs.slo.error_rate --obs.slo.fast_window_s --obs.slo.slow_window_s "
               "--obs.slo.burn_rate --obs.slo.shed_factor — burn-rate monitor, "
               "breach events, fleet admission tightening")
+        print("timeline (serve): --obs.timeline.steps=<n> --obs.timeline.export"
+              "=<timeline.jsonl> --obs.timeline.swap_gbps — per-pass scheduler "
+              "ring (admissions, slot occupancy, preemption post-mortems); "
+              "analyze with obs timeline")
         print("obs report: --events=<events.jsonl> [--snapshot=<snapshot.json>] "
               "[--top N] [--json true] — offline latency/compile/padding report")
+        print("obs timeline: --timeline=<timeline.jsonl> [--events=<events.jsonl>] "
+              "[--snapshot=<snapshot.json>] [--trace_out=<trace.json>] — "
+              "per-slot gantt + per-request decomposition + Chrome-trace export")
         print(f"data modules: {sorted(self.family.data_registry)}")
 
 

@@ -315,6 +315,69 @@ class ServingEngine:
         #: recorder & incident bundles"); None skips the seam, the same
         #: contract as ``tracer``/``chaos``
         self.flight_recorder = None
+        #: optional scheduler step timeline
+        #: (:class:`~perceiver_io_tpu.observability.StepTimeline`,
+        #: docs/observability.md "Scheduler timeline & post-mortems"):
+        #: when attached, every ``step()`` pass appends one structured
+        #: record — admissions / token emissions / terminal dispositions
+        #: this pass plus per-phase wall ms on the engine clock. None
+        #: skips the seam entirely, the same contract as ``tracer``.
+        self.timeline = None
+        self._tl_draft: Optional[dict] = None  # per-pass event accumulator
+        self._tl_marks: Optional[dict] = None  # per-pass phase marks
+
+    # -- scheduler timeline seams -------------------------------------------
+    def _tl_event(self, kind: str, **fields) -> None:
+        """Accumulate one timeline event under ``kind`` for the pass in
+        flight (or the NEXT pass for out-of-band calls like ``cancel()``
+        between steps — deterministic either way)."""
+        if self.timeline is None:
+            return
+        if self._tl_draft is None:
+            self._tl_draft = {}
+        self._tl_draft.setdefault(kind, []).append(fields)
+
+    def _tl_mark(self, key: str, value) -> None:
+        if self._tl_marks is not None:
+            self._tl_marks[key] = value
+
+    def _tl_mark_clock(self, key: str) -> None:
+        """Phase-boundary clock mark — reads the clock ONLY when a pass is
+        being recorded, so a timeline-less engine's step stays byte-
+        identical (FakeClock drills included)."""
+        if self._tl_marks is not None:
+            self._tl_marks[key] = self._clock()
+
+    def _run_pass(self, pass_fn):
+        """Run one scheduler pass, appending its timeline record on every
+        exit path (early returns and raises included)."""
+        if self.timeline is None:
+            return pass_fn()
+        t0 = self._clock()
+        self._tl_marks = {}
+        try:
+            return pass_fn()
+        finally:
+            self._tl_record(t0, self._clock())
+
+    def _tl_record(self, t0: float, t1: float) -> None:
+        """Build and append the bucket engine's per-pass record; the slot
+        engine overrides this with its occupancy/pool shape."""
+        draft, self._tl_draft = self._tl_draft, None
+        marks, self._tl_marks = self._tl_marks or {}, None
+        phases = {"total": round((t1 - t0) * 1e3, 3)}
+        for key in ("assemble_ms", "execute_ms"):
+            if key in marks:
+                phases[key[: -len("_ms")]] = round(marks[key], 3)
+        rec = {
+            "engine": "bucket",
+            "t_start_s": round(t0, 6),
+            "t_end_s": round(t1, 6),
+            "queue_depth": len(self._queue),
+            "phases_ms": phases,
+        }
+        rec.update(draft or {})
+        self.timeline.append(rec)
 
     def _observe_token_latency(self, name: str, value_ms: float) -> None:
         """One TTFT / inter-token observation: engine registry first (the
@@ -550,6 +613,10 @@ class ServingEngine:
     def _finish(self, req: ServeRequest, status: str, *, error: Optional[str] = None) -> None:
         req.status = status
         req.error = error
+        self._tl_event(
+            "finished", request_id=req.request_id, status=status,
+            tenant=req.tenant, priority=req.priority,
+        )
         if status == "ok":
             self.registry.inc("serving_requests_completed_total")
         elif status == "timed_out":
@@ -656,6 +723,9 @@ class ServingEngine:
         injected) fails every request in this micro-batch but leaves the
         rest of the queue intact.
         """
+        return self._run_pass(self._step_pass)
+
+    def _step_pass(self) -> int:
         disposed = self._expire_overdue()
         if not self._queue:
             return disposed
@@ -699,6 +769,14 @@ class ServingEngine:
         batch_index = int(self.registry.inc("serving_batches_total"))
         assemble_ms = (self._clock() - assemble_t0) * 1e3
         self.registry.observe("serving_batch_assembly_ms", assemble_ms)
+        self._tl_mark("assemble_ms", assemble_ms)
+        if self.timeline is not None:
+            for req in picked:
+                self._tl_event(
+                    "admitted", request_id=req.request_id,
+                    tenant=req.tenant, priority=req.priority,
+                    bucket=[b, length],
+                )
         batch_span = None
         if self.tracer is not None:
             batch_span = self.tracer.start_span(
@@ -735,6 +813,7 @@ class ServingEngine:
         # plus dispatch — the per-batch execute phase of the trace.
         execute_ms = (self._clock() - execute_t0) * 1e3
         self.registry.observe("serving_device_execute_ms", execute_ms)
+        self._tl_mark("execute_ms", execute_ms)
         if self.profiler_trigger is not None:
             self.profiler_trigger.observe(execute_ms)
         if batch_span is not None:
@@ -764,6 +843,12 @@ class ServingEngine:
             ttft_ms = (done_at - req.ttft_from_s) * 1e3
             self._observe_token_latency("serving_ttft_ms", ttft_ms)
             self._observe_token_latency("serving_inter_token_ms", itl_ms)
+            if self.timeline is not None:
+                self._tl_event(
+                    "tokens", request_id=req.request_id, first=True,
+                    ttft_ms=round(ttft_ms, 3), itl_ms=round(itl_ms, 3),
+                    batch_granular=True,
+                )
             if self.tracer is not None:
                 self.tracer.event(
                     "serving.first_token", trace_id=req.trace_id,
@@ -861,7 +946,7 @@ class ServingEngine:
         from perceiver_io_tpu.observability import default_ledger
 
         ledger = default_ledger().rollup()
-        return {
+        out = {
             **counters,
             "queued": len(self._queue),
             "compiles": cache["misses"],
@@ -890,6 +975,11 @@ class ServingEngine:
                 "batch_sizes": list(self.table.batch_sizes),
             },
         }
+        if self.timeline is not None:
+            # scheduler-timeline rollup (docs/observability.md "Scheduler
+            # timeline & post-mortems"): pass/event totals over the ring
+            out["timeline"] = self.timeline.summary()
+        return out
 
     def health(self) -> dict:
         """Readiness snapshot for a serving front end: ``ready`` means the

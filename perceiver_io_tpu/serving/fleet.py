@@ -1511,6 +1511,19 @@ class FleetRouter:
                 for r in self.replicas
             ],
         })
+        # fleet-wide per-tenant rollup (docs/observability.md "Scheduler
+        # timeline & post-mortems"): replicas attribute independently —
+        # pool pages, generated tokens, and preemption victims per tenant
+        # — so the fleet view is the field-wise sum, the same shape each
+        # replica's engine stats() reports
+        tenants: dict = {}
+        for rep in out["per_replica"]:
+            for key, fields in (rep["engine"].get("tenants") or {}).items():
+                agg = tenants.setdefault(key, {})
+                for field, value in fields.items():
+                    agg[field] = agg.get(field, 0) + value
+        if tenants:
+            out["tenants"] = {k: tenants[k] for k in sorted(tenants)}
         return out
 
     def health(self) -> dict:

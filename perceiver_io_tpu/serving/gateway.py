@@ -77,6 +77,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from perceiver_io_tpu.observability.timeline import tenant_label
 from perceiver_io_tpu.reliability import QueueFull
 
 #: stream framings the gateway speaks
@@ -204,6 +205,9 @@ class StreamingGateway:
             threshold=mass_disconnect_threshold,
             window_s=mass_disconnect_window_s, clock=clock,
         )
+        #: accepted streams per sanitized tenant label (the wire half of
+        #: the per-tenant attribution the engines carry in their stats())
+        self._streams_by_tenant: Dict[str, int] = {}
         self.max_streams = max_streams
         self.idle_sleep_s = float(idle_sleep_s)
         # the fleet router polls its own monitor per step(); polling it
@@ -657,6 +661,11 @@ class StreamingGateway:
         self._next_stream_id += 1
         self._streams[handle.request_id] = stream
         self.registry.inc("gateway_streams_total")
+        # per-tenant wire attribution (docs/observability.md "Scheduler
+        # timeline & post-mortems"): accepted streams per sanitized tenant
+        # label, rolled up into stats() beside the engine's page/token view
+        tkey = tenant_label(tenant)
+        self._streams_by_tenant[tkey] = self._streams_by_tenant.get(tkey, 0) + 1
         self.registry.set_gauge("gateway_streams_active", len(self._streams))
         head = (
             "HTTP/1.1 200 OK\r\n"
@@ -790,6 +799,7 @@ class StreamingGateway:
                 "p95": self.registry.percentile("gateway_socket_ttft_ms", 95.0),
             },
             "driver_errors": len(self.driver_errors),
+            "streams_by_tenant": dict(sorted(self._streams_by_tenant.items())),
             # prefix sharing (docs/serving.md "Prefix sharing"): a client
             # disconnect's cancellation reclaim is refcount-aware — the
             # cancelled stream's SHARED pages deref (cached prefixes

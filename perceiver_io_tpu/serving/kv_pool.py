@@ -139,6 +139,11 @@ class KVPagePool:
         #: FALLIBLE for them (:class:`PoolExhausted` = the engine's
         #: preemption trigger) instead of an accounting-bug ValueError.
         self._soft: Dict[int, int] = {}
+        #: slot -> owner label (the engine's sanitized tenant label) for
+        #: per-tenant pool attribution; cleared on :meth:`release`. The
+        #: pool never interprets the label — it only sums mapped blocks
+        #: per owner for :meth:`stats` (``in_use_by_owner``).
+        self._owner: Dict[int, str] = {}
 
     # -- sizing -------------------------------------------------------------
     def blocks_needed(self, tokens: int) -> int:
@@ -422,6 +427,7 @@ class KVPagePool:
         mapped.clear()
         self._reserved[slot] = 0
         self._soft.pop(slot, None)
+        self._owner.pop(slot, None)
         self._table[slot, :] = 0
         return freed
 
@@ -437,6 +443,30 @@ class KVPagePool:
 
     def table_row(self, slot: int):
         return self._table[slot]
+
+    def set_owner(self, slot: int, owner: Optional[str]) -> None:
+        """Tag ``slot``'s blocks with an owner label (the engine's
+        sanitized tenant label) for per-tenant attribution in
+        :meth:`stats`; ``None`` clears the tag. Cleared automatically on
+        :meth:`release` — a freed slot carries no stale attribution."""
+        if owner is None:
+            self._owner.pop(slot, None)
+        else:
+            self._owner[slot] = str(owner)
+
+    def in_use_by_owner(self) -> Dict[str, int]:
+        """Mapped blocks summed per owner label; untagged slots with
+        mapped blocks attribute to ``"default"``. Shared (refcounted)
+        blocks count once per mapping — attribution, so a tenant holding a
+        reference is charged for it even when another tenant shares the
+        physical block."""
+        held: Dict[str, int] = {}
+        for slot, mapped in self._mapped.items():
+            if not mapped:
+                continue
+            owner = self._owner.get(slot, "default")
+            held[owner] = held.get(owner, 0) + len(mapped)
+        return dict(sorted(held.items()))
 
     def mapped_blocks(self, slot: int) -> int:
         return len(self._mapped[slot])
@@ -494,6 +524,10 @@ class KVPagePool:
             # the next boundary-crossing PoolExhausted
             "lazy_slots": len(self._soft),
             "headroom_blocks": self.headroom_blocks,
+            # per-tenant pool attribution (docs/observability.md
+            # "Scheduler timeline & post-mortems"): mapped blocks summed
+            # per owner label the engine tagged at admission
+            "in_use_by_owner": self.in_use_by_owner(),
         }
 
 

@@ -101,7 +101,8 @@ faults failing only resident requests while the queue survives.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Union
+from collections import deque
+from typing import Deque, Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -126,6 +127,7 @@ from perceiver_io_tpu.inference.generate import (
     register_executor_cache,
 )
 from perceiver_io_tpu.inference.samplers import apply_min_new_tokens, sample_logits
+from perceiver_io_tpu.observability.timeline import tenant_label, tier_label
 from perceiver_io_tpu.ops import paged_attention as paged_ops
 from perceiver_io_tpu.serving.engine import ServeRequest, ServingEngine, _round_ms
 from perceiver_io_tpu.serving.kv_pool import (
@@ -866,6 +868,7 @@ class SlotServingEngine(ServingEngine):
                  prefix_cache: Optional[str] = None,
                  preemption: Optional[str] = None,
                  admit_headroom_blocks: int = 0,
+                 swap_link_gbps: float = 16.0,
                  mesh=None, **kwargs):
         super().__init__(
             model, params, config, table, decode_strategy=decode_strategy,
@@ -1007,9 +1010,33 @@ class SlotServingEngine(ServingEngine):
                 "pages need kv_layout='paged' (or 'paged_int8'; dense slots "
                 "reserve their worst case by construction)"
             )
+        if swap_link_gbps <= 0:
+            raise ValueError(
+                f"swap_link_gbps must be > 0, got {swap_link_gbps}"
+            )
+        #: modeled host-link bandwidth (decimal GB/s) for the preemption
+        #: post-mortems' hypothetical swap cost — ROADMAP item 2's
+        #: recompute-vs-swap crossover is measured against this rate
+        self.swap_link_gbps = float(swap_link_gbps)
         #: preemption accounting: tier -> victims preempted at that tier
         #: (the kv_preemptions_total by-tier breakdown stats() reports)
         self._preempted_by_tier: Dict[int, int] = {}
+        #: per-victim preemption post-mortems (docs/observability.md
+        #: "Scheduler timeline & post-mortems"): actual recompute cost
+        #: (tokens replayed x measured decode-step ms) vs the modeled
+        #: host-swap cost (victim bytes / swap_link_gbps). Bounded ring;
+        #: the running totals survive eviction.
+        self._postmortems: Deque[dict] = deque(maxlen=256)
+        self._postmortem_totals = {
+            "count": 0, "tokens_discarded": 0, "pages_released": 0,
+            "victim_bytes": 0, "recompute_est_ms": 0.0, "swap_est_ms": 0.0,
+        }
+        #: per-tenant attribution (sanitized labels — observability.
+        #: tenant_label): tokens generated and victims preempted; resident
+        #: pool pages come live from _tenant_pages()
+        self._tokens_by_tenant: Dict[str, int] = {}
+        self._preempted_by_tenant: Dict[str, int] = {}
+        self._tenant_gauge_keys: set = set()
         self._preempts_this_step = 0
         self._kv_counter_base = {"allocs": 0, "frees": 0}
         self._kv_waiting_id: Optional[int] = None  # last head counted waiting
@@ -1172,6 +1199,22 @@ class SlotServingEngine(ServingEngine):
                 self.registry.set_gauge(
                     "kv_prefix_cached_blocks", self._prefix_index.cached_blocks
                 )
+            # per-tenant attribution (docs/observability.md "Scheduler
+            # timeline & post-mortems"): resident pool pages per tenant,
+            # published as one gauge per (sanitized) tenant label. Gauges
+            # for tenants that no longer hold pages drop to 0 rather than
+            # lingering at their last value.
+            live: Dict[str, int] = {}
+            for tenant, held in self._tenant_pages().items():
+                key = tenant_label(tenant)
+                live[key] = live.get(key, 0) + held
+            for key, held in live.items():
+                self.registry.set_gauge(
+                    f"kv_pool_tenant_blocks_in_use_{key}", held
+                )
+            for key in self._tenant_gauge_keys - set(live):
+                self.registry.set_gauge(f"kv_pool_tenant_blocks_in_use_{key}", 0)
+            self._tenant_gauge_keys |= set(live)
             base = self._kv_counter_base
             if pool.allocs_total > base["allocs"]:
                 self.registry.inc(
@@ -1793,9 +1836,50 @@ class SlotServingEngine(ServingEngine):
         tier = int(req.priority)
         # per-tier family (ledger's retrace_reason_* naming convention);
         # negative tiers spell the sign out — metric names can't hold '-'
-        tier_key = f"neg{-tier}" if tier < 0 else str(tier)
-        self.registry.inc(f"kv_preemptions_tier_{tier_key}_total")
+        self.registry.inc(f"kv_preemptions_tier_{tier_label(tier)}_total")
         self._preempted_by_tier[tier] = self._preempted_by_tier.get(tier, 0) + 1
+        tkey = tenant_label(req.tenant)
+        self._preempted_by_tenant[tkey] = \
+            self._preempted_by_tenant.get(tkey, 0) + 1
+        # post-mortem (docs/observability.md "Scheduler timeline &
+        # post-mortems"): the recompute cost this victim will actually pay
+        # (discarded tokens x the measured decode-step ms) against the
+        # host-swap cost a PCIe round trip WOULD have cost (victim bytes /
+        # the modeled link rate, one direction) — ROADMAP item 2's
+        # crossover curve, measured instead of assumed.
+        step_ms = self.registry.percentile("serving_decode_step_ms", 50.0) or 0.0
+        victim_bytes = pages * self.kv_block_size * (
+            self._kv_token_bytes + self._kv_scale_token_bytes
+        )
+        recompute_ms = generated * step_ms
+        swap_ms = victim_bytes / (self.swap_link_gbps * 1e9) * 1e3
+        pm = {
+            "request_id": req.request_id,
+            "tenant": req.tenant,
+            "priority": tier,
+            "slot": victim.slot,
+            "tokens_discarded": generated,
+            "pages_released": pages,
+            "victim_bytes": int(victim_bytes),
+            "decode_step_ms": round(step_ms, 3),
+            "recompute_est_ms": round(recompute_ms, 3),
+            "swap_est_ms": round(swap_ms, 3),
+            # positive = swapping out would have been cheaper than replay
+            "swap_advantage_ms": round(recompute_ms - swap_ms, 3),
+        }
+        self._postmortems.append(pm)
+        totals = self._postmortem_totals
+        totals["count"] += 1
+        totals["tokens_discarded"] += generated
+        totals["pages_released"] += pages
+        totals["victim_bytes"] += int(victim_bytes)
+        totals["recompute_est_ms"] += recompute_ms
+        totals["swap_est_ms"] += swap_ms
+        self._tl_event(
+            "preempted", request_id=req.request_id, slot=victim.slot,
+            tenant=req.tenant, priority=tier, tokens_discarded=generated,
+            pages_released=pages, beneficiary=beneficiary,
+        )
         self._update_slot_gauges()
         if self.tracer is not None:
             self.tracer.event(
@@ -1818,6 +1902,28 @@ class SlotServingEngine(ServingEngine):
                 blocks=pool["blocks"],
                 blocks_in_use=pool["in_use"],
             )
+
+    def postmortems(self) -> dict:
+        """The preemption post-mortem rollup (docs/observability.md
+        "Scheduler timeline & post-mortems"): lifetime recompute-vs-swap
+        totals plus the last few per-victim records. Public so the flight
+        recorder sources it into incident bundles and BENCH's preemption
+        probe can diff it per arm; also embedded in
+        ``stats()["preemption"]["postmortems"]``."""
+        totals = self._postmortem_totals
+        return {
+            "count": totals["count"],
+            "tokens_discarded": totals["tokens_discarded"],
+            "pages_released": totals["pages_released"],
+            "victim_bytes": totals["victim_bytes"],
+            "recompute_est_ms": round(totals["recompute_est_ms"], 3),
+            "swap_est_ms": round(totals["swap_est_ms"], 3),
+            "swap_advantage_ms": round(
+                totals["recompute_est_ms"] - totals["swap_est_ms"], 3
+            ),
+            "swap_link_gbps": self.swap_link_gbps,
+            "recent": list(self._postmortems)[-8:],
+        }
 
     def _preempt_lower_tier(self, head: ServeRequest) -> bool:
         """Admission-time preemption: a strictly-higher-tier head may
@@ -1869,6 +1975,10 @@ class SlotServingEngine(ServingEngine):
         if not req.preemptions:
             return
         self.registry.inc("kv_readmissions_total")
+        self._tl_event(
+            "readmitted", request_id=req.request_id, slot=slot,
+            tenant=req.tenant, preemptions=req.preemptions,
+        )
         if self.tracer is not None:
             self.tracer.event(
                 "serving.readmitted", trace_id=req.trace_id, slot=slot,
@@ -1959,6 +2069,7 @@ class SlotServingEngine(ServingEngine):
             # positions fill)
             self._reserve_admit(slot, int(req.prompt.size), cfg.max_new_tokens,
                                 pessimistic=bool(req.preemptions))
+            self._pool.set_owner(slot, tenant_label(req.tenant))
             self._pool.ensure(slot, int(req.prompt.size))
             self._push_table()
             self._update_kv_gauges()
@@ -1986,6 +2097,10 @@ class SlotServingEngine(ServingEngine):
         self._slots[slot] = _Slot(
             req=req, slot=slot, max_new=cfg.max_new_tokens,
             m=min(bucket_len, cfg.num_latents),
+        )
+        self._tl_event(
+            "admitted", request_id=req.request_id, slot=slot,
+            tenant=req.tenant, priority=req.priority, chunks=0,
         )
         if self.tracer is not None:
             self.tracer.event(
@@ -2036,6 +2151,8 @@ class SlotServingEngine(ServingEngine):
         self.registry.observe("serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3)
         self._note_readmitted(req, slot)
         stage_k = stage_v = None
+        if self._pool is not None:
+            self._pool.set_owner(slot, tenant_label(req.tenant))
         if plan is not None:
             # shared path: map the hit's pages (reserve excludes the
             # referenced blocks; the partial block COWs before any write)
@@ -2135,6 +2252,10 @@ class SlotServingEngine(ServingEngine):
         self.registry.observe("serving_prefill_chunk_ms", chunk_ms)
         if not final:
             self.registry.inc("serving_prefill_chunks_total")
+        self._tl_event(
+            "chunks", request_id=req.request_id, slot=admit.slot,
+            chunk=i, final=final, ms=round(chunk_ms, 3),
+        )
         if self.tracer is not None:
             self.tracer.event(
                 "serving.prefill_chunk", trace_id=req.trace_id, slot=admit.slot,
@@ -2154,6 +2275,11 @@ class SlotServingEngine(ServingEngine):
             self._slots[admit.slot] = _Slot(
                 req=req, slot=admit.slot, max_new=req.config.max_new_tokens,
                 m=admit.m0,
+            )
+            self._tl_event(
+                "admitted", request_id=req.request_id, slot=admit.slot,
+                tenant=req.tenant, priority=req.priority,
+                chunks=len(admit.offsets),
             )
             if self.tracer is not None:
                 self.tracer.event(
@@ -2354,6 +2480,53 @@ class SlotServingEngine(ServingEngine):
         this call; ``pending()`` — not the return value — says whether more
         work remains (a mid-generation step legitimately disposes of 0).
         """
+        return self._run_pass(self._step_pass)
+
+    def _tl_record(self, t0: float, t1: float) -> None:
+        """Slot-engine per-pass timeline record: the bucket shape plus the
+        slot occupancy vector, real-vs-padded decode rows, KV pool
+        occupancy, and per-tenant resident pages."""
+        draft, self._tl_draft = self._tl_draft, None
+        marks, self._tl_marks = self._tl_marks or {}, None
+        phases = {"total": round((t1 - t0) * 1e3, 3)}
+        if "admit_done_s" in marks:
+            phases["admit"] = round((marks["admit_done_s"] - t0) * 1e3, 3)
+        if "decode_ms" in marks:
+            phases["decode"] = round(marks["decode_ms"], 3)
+        if "token_at_s" in marks:
+            phases["account"] = round((t1 - marks["token_at_s"]) * 1e3, 3)
+        rec = {
+            "engine": "slots",
+            "t_start_s": round(t0, 6),
+            "t_end_s": round(t1, 6),
+            "queue_depth": len(self._queue),
+            "slots": [
+                None if s is None else s.req.request_id for s in self._slots
+            ],
+            "phases_ms": phases,
+        }
+        if "rows_active" in marks:
+            active = int(marks["rows_active"])
+            rec["rows"] = {
+                "total": self.slots, "real": active,
+                "padded": self.slots - active,
+            }
+        if self._pool is not None:
+            rec["pool"] = {
+                "in_use": self._pool.in_use,
+                "reserved": self._pool.reserved,
+                "headroom": self._pool.headroom_blocks,
+            }
+            tenants: Dict[str, int] = {}
+            for tenant, held in self._tenant_pages().items():
+                key = tenant_label(tenant)
+                tenants[key] = tenants.get(key, 0) + held
+            if tenants:
+                rec["tenants"] = dict(sorted(tenants.items()))
+        rec.update(draft or {})
+        self.timeline.append(rec)
+
+    def _step_pass(self) -> int:
         disposed = self._expire_overdue()
         now = self._clock()
         for entry in self._active():
@@ -2537,6 +2710,7 @@ class SlotServingEngine(ServingEngine):
                     f"prefill fault poisoned the slot state: {type(e).__name__}: {e}"
                 )
         self._update_slot_gauges()
+        self._tl_mark_clock("admit_done_s")
         active = self._active()
         if not active:
             return disposed
@@ -2625,6 +2799,8 @@ class SlotServingEngine(ServingEngine):
             return disposed + self._fail_resident(f"{type(e).__name__}: {e}")
         decode_ms = (self._clock() - t0) * 1e3
         self.registry.observe("serving_decode_step_ms", decode_ms)
+        self._tl_mark("decode_ms", decode_ms)
+        self._tl_mark("rows_active", len(active))
         if self.profiler_trigger is not None:
             self.profiler_trigger.observe(decode_ms)
         self.registry.inc("serving_decode_steps_total")
@@ -2646,6 +2822,9 @@ class SlotServingEngine(ServingEngine):
         # (previous token's instant → this one, so a long admission or a
         # boundary-variant step shows up in every RESIDENT row's ITL).
         token_at = self._clock()
+        self._tl_mark("token_at_s", token_at)
+        tier_tokens: Dict[str, int] = {}
+        tenant_tokens: Dict[str, int] = {}
         for entry in active:
             token = int(tokens[entry.slot])
             first = not entry.emitted
@@ -2659,20 +2838,41 @@ class SlotServingEngine(ServingEngine):
             if first:
                 ttft_ms = (token_at - entry.req.ttft_from_s) * 1e3
                 self._observe_token_latency("serving_ttft_ms", ttft_ms)
+                if self.timeline is not None:
+                    self._tl_event(
+                        "tokens", request_id=entry.req.request_id,
+                        slot=entry.slot, first=True,
+                        ttft_ms=round(ttft_ms, 3),
+                    )
                 if self.tracer is not None:
                     self.tracer.event(
                         "serving.first_token", trace_id=entry.req.trace_id,
                         slot=entry.slot, ttft_ms=round(ttft_ms, 3),
                     )
             else:
-                self._observe_token_latency(
-                    "serving_inter_token_ms",
-                    (token_at - entry.last_token_at) * 1e3,
-                )
+                itl_ms = (token_at - entry.last_token_at) * 1e3
+                self._observe_token_latency("serving_inter_token_ms", itl_ms)
+                if self.timeline is not None:
+                    self._tl_event(
+                        "tokens", request_id=entry.req.request_id,
+                        slot=entry.slot, first=False,
+                        itl_ms=round(itl_ms, 3),
+                    )
             entry.last_token_at = token_at
+            # per-tier / per-tenant token attribution, batched to one
+            # registry/dict bump per label per step (hot-path discipline)
+            tkey = tier_label(entry.req.priority)
+            tier_tokens[tkey] = tier_tokens.get(tkey, 0) + 1
+            nkey = tenant_label(entry.req.tenant)
+            tenant_tokens[nkey] = tenant_tokens.get(nkey, 0) + 1
             if (eos is not None and token == eos) or len(entry.emitted) >= entry.max_new:
                 self._retire(entry, "ok")
                 disposed += 1
+        for tkey, n in tier_tokens.items():
+            self.registry.inc(f"serving_tokens_tier_{tkey}_total", n)
+        for nkey, n in tenant_tokens.items():
+            self._tokens_by_tenant[nkey] = \
+                self._tokens_by_tenant.get(nkey, 0) + n
         self._update_slot_gauges()
         return disposed
 
@@ -2910,7 +3110,13 @@ class SlotServingEngine(ServingEngine):
                 "preemptions": int(counts.get("kv_preemptions_total", 0)),
                 "readmissions": int(counts.get("kv_readmissions_total", 0)),
                 "by_tier": dict(sorted(self._preempted_by_tier.items())),
+                "by_tenant": dict(sorted(self._preempted_by_tenant.items())),
                 "headroom_blocks": self._pool.headroom_blocks,
+                # per-victim recompute-vs-swap post-mortems
+                # (docs/observability.md "Scheduler timeline &
+                # post-mortems"): the measured crossover evidence ROADMAP
+                # item 2's host-swap policy starts from
+                "postmortems": self.postmortems(),
             }
             out["prefix_cache"] = {"enabled": self._prefix_index is not None}
             if self._prefix_index is not None:
@@ -2937,6 +3143,28 @@ class SlotServingEngine(ServingEngine):
                     ),
                     **self._prefix_index.stats(),
                 })
+        # per-tenant attribution rollup (sanitized labels): resident pool
+        # pages + generated tokens + preemption victims per tenant — the
+        # fleet router sums these across replicas, and the serve CLI's
+        # serve_stats carries the fleet-level rollup
+        pages_by_tenant: Dict[str, int] = {}
+        if self._pool is not None:
+            for tenant, held in self._tenant_pages().items():
+                key = tenant_label(tenant)
+                pages_by_tenant[key] = pages_by_tenant.get(key, 0) + held
+        tenant_keys = (
+            set(pages_by_tenant) | set(self._tokens_by_tenant)
+            | set(self._preempted_by_tenant)
+        )
+        if tenant_keys:
+            out["tenants"] = {
+                key: {
+                    "blocks_in_use": pages_by_tenant.get(key, 0),
+                    "tokens": self._tokens_by_tenant.get(key, 0),
+                    "preemptions": self._preempted_by_tenant.get(key, 0),
+                }
+                for key in sorted(tenant_keys)
+            }
         return out
 
     def health(self) -> dict:

@@ -130,6 +130,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "timeline: scheduler flight-deck test (per-step timeline ring + "
+        "JSONL export, timeline<->span join, exact TTFT/ITL telescoping, "
+        "Chrome-trace export, preemption post-mortems, per-tenant/per-tier "
+        "attribution; observability/timeline.py, observability/report.py, "
+        "serving/slots.py; docs/observability.md \"Scheduler timeline & "
+        "post-mortems\"); CPU-fast, runs in the tier-1 suite with a tight "
+        "per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )

@@ -1,0 +1,86 @@
+"""Regenerate the checked-in scheduler-timeline fixture.
+
+Runs the same deterministic FakeClock drill as ``tests/test_timeline.py``
+(preemption + replay, two tenants, two priority tiers, chunked prefill) and
+writes ``timeline.jsonl`` / ``events.jsonl`` / ``expected.txt`` next to this
+script. ``expected.txt`` pins the rendered flight deck byte-for-byte —
+regenerate (``python tests/fixtures/timeline/generate.py`` from the repo
+root) whenever the record shape or the ``obs timeline`` renderer changes,
+and review the diff like any other golden file. ``make timeline`` replays
+the analyzer over these files.
+"""
+import os
+import sys
+
+# runnable from anywhere: the repo root is three levels up
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.inference.generate import GenerationConfig
+from perceiver_io_tpu.inference.samplers import SamplingConfig
+from perceiver_io_tpu.models.text.clm import (
+    CausalLanguageModel,
+    CausalLanguageModelConfig,
+)
+from perceiver_io_tpu.observability import MetricsRegistry, StepTimeline
+from perceiver_io_tpu.observability.report import run_timeline
+from perceiver_io_tpu.observability.tracing import JsonlSpanSink, Tracer
+from perceiver_io_tpu.reliability import FakeClock
+from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TINY = dict(vocab_size=71, max_seq_len=32, max_latents=8, num_channels=16,
+            num_heads=2, num_self_attention_layers=1,
+            cross_attention_dropout=0.0)
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+def main() -> None:
+    model = CausalLanguageModel(CausalLanguageModelConfig(**TINY))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32), 8
+    )["params"]
+    ev_path = os.path.join(HERE, "events.jsonl")
+    tl_path = os.path.join(HERE, "timeline.jsonl")
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    sink = JsonlSpanSink(ev_path)
+    eng = SlotServingEngine(
+        model=model, params=params,
+        config=GenerationConfig(max_new_tokens=8, sampling=GREEDY),
+        table=BucketTable(prompt_lens=(8,), batch_sizes=(1,)),
+        slots=4, kv_layout="paged", kv_block_size=4, kv_blocks=10,
+        preemption="recompute", prefill_chunk=4, clock=clock,
+        registry=reg, tracer=Tracer(clock=clock, sink=sink),
+    )
+    eng.timeline = StepTimeline(cap=128, registry=reg)
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        prompt = rng.integers(1, 70, size=6).astype(np.int32)
+        eng.submit(
+            prompt,
+            config=GenerationConfig(
+                max_new_tokens=3 if i % 2 == 0 else 14, sampling=GREEDY
+            ),
+            tenant="acme" if i % 3 == 0 else None,
+            priority=1 if i % 4 == 0 else 0,
+        )
+        clock.advance(0.001)
+    while eng.pending():
+        eng.step()
+        clock.advance(0.002)
+    sink.close()
+    n = eng.timeline.write_jsonl(tl_path)
+    text = run_timeline(tl_path, ev_path, top=10)
+    with open(os.path.join(HERE, "expected.txt"), "w") as fh:
+        fh.write(text + "\n")
+    print(f"wrote {n} step records + {len(text.splitlines())} rendered lines")
+
+
+if __name__ == "__main__":
+    main()

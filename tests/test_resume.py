@@ -148,6 +148,7 @@ def test_resume_without_snapshot_raises(tmp_path):
     mgr.close()
 
 
+@pytest.mark.slow  # 2026-08 audit: plain kill/resume keeps tier-1 coverage
 def test_resume_into_new_root_does_not_touch_source(tmp_path):
     """Resuming run A's snapshot into root B writes B's snapshots under
     B/resume and leaves A's snapshot dir untouched."""
@@ -185,6 +186,7 @@ def test_skip_batches_matches_continuous_stream():
     assert resumed == continuous[7:]
 
 
+@pytest.mark.slow  # 2026-08 audit: heaviest tier-1 test; kill/resume stays
 def test_sigterm_preemption_snapshots_and_resumes(tmp_path):
     """SIGTERM mid-fit finishes the in-flight step, snapshots, and exits;
     --resume then continues to the same final state as an uninterrupted

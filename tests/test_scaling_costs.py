@@ -67,6 +67,7 @@ def _flops(compiled) -> float:
     return float(cost.get("flops", float("nan")))
 
 
+@pytest.mark.slow  # 2026-08 audit: fsdp sharding cost test keeps tier-1 coverage
 def test_dp_weak_scaling_per_device_flops_flat():
     f1 = _flops(_compiled_step(MeshConfig(data=1), 2))
     f8 = _flops(_compiled_step(MeshConfig(data=8), 16))

@@ -1,0 +1,473 @@
+"""Scheduler flight-deck tests (docs/observability.md "Scheduler timeline &
+post-mortems"): the per-step timeline ring, its JSONL export, the
+timeline<->span join, the EXACT TTFT/ITL telescoping bar, Chrome-trace
+export schema, preemption post-mortems, and the ``obs timeline`` CLI.
+
+The core drill runs a preempting multi-tenant paged slot engine entirely on
+a FakeClock, so every latency in the ring and the span file is exact — the
+analyzer's per-request phase decomposition must telescope to the terminal
+span duration with 0.0 ms unattributed, including requests that were
+preempted and replayed.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.inference.generate import GenerationConfig
+from perceiver_io_tpu.inference.samplers import SamplingConfig
+from perceiver_io_tpu.models.text.clm import (
+    CausalLanguageModel,
+    CausalLanguageModelConfig,
+)
+from perceiver_io_tpu.observability import MetricsRegistry, StepTimeline
+from perceiver_io_tpu.observability.timeline import (
+    TIMELINE_SCHEMA,
+    TimelineArgs,
+    read_timeline_jsonl,
+    tenant_label,
+    tier_label,
+)
+from perceiver_io_tpu.observability.tracing import (
+    JsonlSpanSink,
+    Tracer,
+    read_events_jsonl,
+)
+from perceiver_io_tpu.reliability import FakeClock
+from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+pytestmark = pytest.mark.timeline
+
+TINY = dict(vocab_size=71, max_seq_len=32, max_latents=8, num_channels=16,
+            num_heads=2, num_self_attention_layers=1,
+            cross_attention_dropout=0.0)
+KEY = jax.random.PRNGKey(0)
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = CausalLanguageModel(CausalLanguageModelConfig(**TINY))
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 8)["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def drill(tiny_model, tmp_path_factory):
+    """One deterministic FakeClock serve drill shared by the analyzer
+    tests: preemption + replay, two tenants, two priority tiers, chunked
+    prefill — every event family the analyzer joins on."""
+    model, params = tiny_model
+    tmp = tmp_path_factory.mktemp("timeline_drill")
+    ev_path = str(tmp / "events.jsonl")
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    sink = JsonlSpanSink(ev_path)
+    tracer = Tracer(clock=clock, sink=sink)
+    eng = SlotServingEngine(
+        model=model, params=params,
+        config=GenerationConfig(max_new_tokens=8, sampling=GREEDY),
+        table=BucketTable(prompt_lens=(8,), batch_sizes=(1,)),
+        slots=4, kv_layout="paged", kv_block_size=4, kv_blocks=10,
+        preemption="recompute", prefill_chunk=4, clock=clock,
+        registry=reg, tracer=tracer,
+    )
+    eng.timeline = StepTimeline(cap=128, registry=reg)
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        prompt = rng.integers(1, 70, size=6).astype(np.int32)
+        eng.submit(
+            prompt,
+            config=GenerationConfig(
+                max_new_tokens=3 if i % 2 == 0 else 14, sampling=GREEDY
+            ),
+            tenant="acme" if i % 3 == 0 else None,
+            priority=1 if i % 4 == 0 else 0,
+        )
+        clock.advance(0.001)
+    while eng.pending():
+        eng.step()
+        clock.advance(0.002)
+    sink.close()
+    tl_path = str(tmp / "timeline.jsonl")
+    eng.timeline.write_jsonl(tl_path)
+    return {
+        "engine": eng, "registry": reg, "tmp": tmp,
+        "timeline_path": tl_path, "events_path": ev_path,
+        "records": eng.timeline.records(),
+        "events": read_events_jsonl(ev_path),
+    }
+
+
+def _trace_to_rid(events):
+    """trace_id -> request_id via the terminal serving.request spans."""
+    return {
+        e["trace_id"]: e["attrs"]["request_id"]
+        for e in events
+        if e.get("span") == "serving.request" and "attrs" in e
+    }
+
+
+# -- ring mechanics ----------------------------------------------------------
+@pytest.mark.timeout(30)
+def test_ring_bounds_eviction_and_summary():
+    reg = MetricsRegistry()
+    tl = StepTimeline(cap=4, registry=reg)
+    for i in range(10):
+        rec = tl.append({"engine": "slots", "tokens": [{"i": i}]})
+        assert rec["step"] == i  # monotone stamp, never reused
+    assert len(tl) == 4 and tl.dropped == 6
+    assert [r["step"] for r in tl.records()] == [6, 7, 8, 9]
+    assert tl.last()["step"] == 9
+    s = tl.summary()
+    assert s == {"steps": 10, "retained": 4, "cap": 4, "dropped": 6,
+                 "events": {"tokens": 4}}
+    counts = reg.counters()
+    assert counts["timeline_steps_total"] == 10
+    assert counts["timeline_records_dropped_total"] == 6
+    assert reg.gauge("timeline_ring_records") == 4
+    with pytest.raises(ValueError, match="cap must be >= 1"):
+        StepTimeline(cap=0)
+
+
+@pytest.mark.timeout(30)
+def test_jsonl_roundtrip_schema_and_torn_tail(tmp_path):
+    tl = StepTimeline(cap=8)
+    for i in range(3):
+        tl.append({"engine": "bucket", "queue_depth": i})
+    path = str(tmp_path / "tl.jsonl")
+    assert tl.write_jsonl(path) == 3
+    with open(path) as fh:
+        header = json.loads(fh.readline())
+    assert header["schema"] == TIMELINE_SCHEMA
+    assert header["steps"] == 3 and header["dropped"] == 0
+    back = read_timeline_jsonl(path)
+    assert back == tl.records()
+    # torn tail from an interrupted writer: parse stops, no raise
+    with open(path, "a") as fh:
+        fh.write('{"step": 3, "engine": "buck')
+    assert read_timeline_jsonl(path) == back
+    # wrong schema is refused outright
+    other = str(tmp_path / "other.jsonl")
+    with open(other, "w") as fh:
+        fh.write('{"schema": "events-v1"}\n')
+    with pytest.raises(ValueError, match="not a step-timeline export"):
+        read_timeline_jsonl(other)
+
+
+@pytest.mark.timeout(30)
+def test_labels_and_args():
+    assert tenant_label(None) == "default"
+    assert tenant_label("acme-eu/1") == "acme_eu_1"
+    assert tenant_label("!!") == "__"
+    assert tier_label(0) == "0" and tier_label(-2) == "neg2"
+    args = TimelineArgs()
+    assert not args.enabled and args.swap_gbps == 16.0
+    assert TimelineArgs(steps=64).enabled
+
+
+# -- the drill: join, telescoping, accounting --------------------------------
+@pytest.mark.timeout(120)
+def test_span_events_join_step_records(drill):
+    """Every serving.preempted / serving.readmitted / serving.prefill_chunk
+    span event appears in the step record covering its timestamp, carrying
+    the same slot (and kind-specific fields) for the same request."""
+    records, events = drill["records"], drill["events"]
+    rid_of = _trace_to_rid(events)
+    joins = {"serving.preempted": "preempted",
+             "serving.readmitted": "readmitted",
+             "serving.prefill_chunk": "chunks"}
+    seen = {k: 0 for k in joins}
+    for ev in events:
+        kind = joins.get(ev.get("span"))
+        if kind is None:
+            continue
+        seen[ev["span"]] += 1
+        rid = rid_of[ev["trace_id"]]
+        attrs = ev["attrs"]
+        hits = [
+            entry
+            for rec in records
+            if rec["t_start_s"] - 1e-6 <= ev["start_s"] <= rec["t_end_s"] + 1e-6
+            for entry in rec.get(kind, ())
+            if entry["request_id"] == rid and entry["slot"] == attrs["slot"]
+        ]
+        assert hits, f"{ev['span']} for {rid} missing from step records"
+        if kind == "preempted":
+            assert any(
+                h["tokens_discarded"] == attrs["tokens_discarded"]
+                and h["pages_released"] == attrs["pages_released"]
+                for h in hits
+            )
+        elif kind == "readmitted":
+            assert any(h["preemptions"] == attrs["preemptions"] for h in hits)
+        elif kind == "chunks":
+            assert any(
+                h["chunk"] == attrs["chunk"] and h["final"] == attrs["final"]
+                for h in hits
+            )
+    # the drill must actually exercise all three families
+    for span, n in seen.items():
+        assert n > 0, f"drill produced no {span} events"
+
+
+@pytest.mark.timeout(120)
+def test_phase_decomposition_telescopes_exactly(drill):
+    """The exactness bar: under FakeClock, ttft + sum(itl) of the segment
+    after the LAST first-token equals the terminal span duration for EVERY
+    request — 0.0 ms unattributed, preempted/replayed requests included."""
+    from perceiver_io_tpu.observability.report import analyze_timeline
+
+    an = analyze_timeline(drill["records"], drill["events"],
+                          snapshot=drill["registry"].snapshot())
+    rows = an["requests"]
+    assert len(rows) == 8
+    for row in rows:
+        assert row["span_ms"] is not None
+        assert row["unattributed_ms"] == 0.0, row
+        assert row["total_ms"] == pytest.approx(
+            row["ttft_ms"] + row["decode_ms"], abs=1e-6
+        )
+    # replay overhead is visible, not hidden: the preempted requests carry
+    # the discarded tokens and a second admission attempt
+    replayed = [r for r in rows if r["replayed_tokens"] > 0]
+    assert replayed and all(r["attempts"] > 1 for r in replayed)
+
+
+@pytest.mark.timeout(120)
+def test_accounting_closes_between_timeline_and_stats(drill):
+    """completed + cancelled + preempted - readmitted closes: the ring's
+    event counts equal the registry counters stats() reports."""
+    from perceiver_io_tpu.observability.report import analyze_timeline
+
+    an = analyze_timeline(drill["records"], drill["events"],
+                          snapshot=drill["registry"].snapshot())
+    acct = an["accounting"]
+    stats = drill["engine"].stats()
+    completed = acct["finished_by_status"].get("ok", 0)
+    cancelled = acct["finished_by_status"].get("cancelled", 0)
+    assert completed == stats["completed"] == 8
+    assert cancelled == stats.get("cancelled", 0) == 0
+    pre = stats["preemption"]
+    assert acct["preempted"] == pre["preemptions"] > 0
+    assert acct["readmitted"] == pre["readmissions"] > 0
+    # every admission is a fresh request or a readmission; the drill drains,
+    # so preemptions all convert to readmissions and the books close
+    assert acct["preempted"] == acct["readmitted"]
+    assert acct["admitted"] == completed + cancelled + acct["readmitted"]
+    # the engine's own stats() carries the ring rollup
+    assert stats["timeline"]["steps"] == len(drill["records"])
+    assert stats["timeline"]["events"]["finished"] == 8
+
+
+@pytest.mark.timeout(120)
+def test_tenant_and_tier_attribution(drill):
+    """Per-tenant pool pages ride each record; the per-tenant / per-tier
+    counter families are published and HELP-covered."""
+    records = drill["records"]
+    tenanted = [r for r in records if r.get("tenants")]
+    assert any("acme" in r["tenants"] for r in tenanted)
+    counts = drill["registry"].counters()
+    assert counts.get("serving_tokens_tier_0_total", 0) > 0
+    assert counts.get("serving_tokens_tier_1_total", 0) > 0
+    assert counts.get("kv_preemptions_tier_0_total", 0) > 0
+
+
+@pytest.mark.timeout(120)
+def test_postmortems_model_and_fields(drill):
+    """postmortems(): lifetime recompute-vs-swap totals plus per-victim
+    records, with the swap estimate tied to the configured link rate."""
+    eng = drill["engine"]
+    pm = eng.postmortems()
+    assert pm["count"] > 0
+    assert pm["tokens_discarded"] > 0 and pm["pages_released"] > 0
+    assert pm["swap_link_gbps"] == 16.0
+    assert pm["swap_advantage_ms"] == pytest.approx(
+        pm["recompute_est_ms"] - pm["swap_est_ms"], abs=2e-3
+    )
+    expect_swap = pm["victim_bytes"] / (pm["swap_link_gbps"] * 1e9) * 1e3
+    assert pm["swap_est_ms"] == pytest.approx(expect_swap, abs=2e-3)
+    assert 1 <= len(pm["recent"]) <= 8
+    victim = pm["recent"][-1]
+    for key in ("request_id", "priority", "tenant", "slot",
+                "tokens_discarded", "pages_released", "victim_bytes",
+                "decode_step_ms", "recompute_est_ms", "swap_est_ms",
+                "swap_advantage_ms"):
+        assert key in victim, key
+    # stats() embeds the same rollup
+    assert eng.stats()["preemption"]["postmortems"]["count"] == pm["count"]
+
+
+@pytest.mark.timeout(120)
+def test_chrome_trace_validates_against_trace_event_schema(drill):
+    """The exported Chrome-trace JSON is loadable by Perfetto /
+    chrome://tracing: object form with traceEvents, every event carries a
+    valid ph, complete events carry numeric ts/dur, metadata names the
+    lanes."""
+    from perceiver_io_tpu.observability.report import chrome_trace
+
+    trace = chrome_trace(drill["records"], drill["events"])
+    assert trace["displayTimeUnit"] == "ms"
+    assert trace["otherData"]["schema"] == TIMELINE_SCHEMA
+    events = trace["traceEvents"]
+    assert events, "empty trace"
+    for ev in events:
+        assert ev["ph"] in {"X", "M", "i"}, ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] in {"t", "p", "g"}
+    meta = {(e["pid"], e["args"]["name"]) for e in events
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any(pid == 1 for pid, _ in meta)  # scheduler lanes
+    assert any(pid == 2 for pid, _ in meta)  # request lanes
+    # request lanes exist and carry the trace ids the span file uses
+    rids = set(_trace_to_rid(drill["events"]).values())
+    req_names = {e["name"] for e in events if e["ph"] == "X" and e["pid"] == 2}
+    assert rids & {n.split(" ")[0] for n in req_names} or req_names
+
+
+@pytest.mark.timeout(120)
+def test_prometheus_help_covers_warmed_multitenant_engine(drill):
+    """PR 9 convention, extended to the new families: a warmed multi-tenant
+    paged+preempting engine publishes NO fallback HELP lines — every # TYPE
+    in the exposition is preceded by a # HELP for the same family."""
+    from perceiver_io_tpu.observability.exporters import to_prometheus_text
+
+    text = to_prometheus_text(drill["registry"])
+    helped = set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split(" ", 3)[2])
+        elif line.startswith("# TYPE "):
+            name = line.split(" ", 3)[2]
+            assert name in helped, f"no # HELP for {name}"
+    for family in ("timeline_steps_total", "timeline_ring_records",
+                   "kv_pool_tenant_blocks_in_use_acme",
+                   "serving_tokens_tier_1_total",
+                   "kv_preemptions_tier_0_total"):
+        assert f"# HELP {family} " in text, family
+
+
+# -- analyzer & CLI ----------------------------------------------------------
+@pytest.mark.timeout(120)
+def test_obs_timeline_renders_flight_deck_and_trace(drill, tmp_path):
+    from perceiver_io_tpu.observability.report import run_timeline
+
+    snap_path = str(tmp_path / "snap.json")
+    with open(snap_path, "w") as fh:
+        json.dump(drill["registry"].snapshot(), fh)
+    trace_out = str(tmp_path / "trace.json")
+    text = run_timeline(drill["timeline_path"], drill["events_path"],
+                        snap_path, trace_out=trace_out, top=10)
+    assert "== scheduler timeline ==" in text
+    assert "== accounting ==" in text and "preempted=" in text
+    assert "== per-request decomposition (worst first) ==" in text
+    assert "== slot gantt ==" in text
+    assert "unattr_ms" in text
+    trace = json.load(open(trace_out))
+    assert trace["traceEvents"]
+    # JSON mode nests the same analysis
+    out = json.loads(run_timeline(drill["timeline_path"],
+                                  drill["events_path"], as_json=True))
+    assert out["meta"]["records"] == len(drill["records"])
+    assert all(r["unattributed_ms"] == 0.0 for r in out["requests"])
+
+
+@pytest.mark.timeout(120)
+def test_cli_obs_timeline_subcommand(drill, tmp_path, capsys):
+    from perceiver_io_tpu.scripts.text import clm as clm_script
+
+    trace_out = str(tmp_path / "trace.json")
+    clm_script.main([
+        "obs", "timeline",
+        f"--timeline={drill['timeline_path']}",
+        f"--events={drill['events_path']}",
+        f"--trace_out={trace_out}",
+        "--top=5",
+    ])
+    text = capsys.readouterr().out
+    assert "scheduler timeline" in text and "== slot gantt ==" in text
+    assert json.load(open(trace_out))["displayTimeUnit"] == "ms"
+    with pytest.raises(SystemExit, match="--timeline"):
+        clm_script.main(["obs", "timeline"])
+    with pytest.raises(SystemExit, match="obs timeline"):
+        clm_script.main([
+            "obs", "timeline", f"--timeline={drill['events_path']}",
+        ])
+
+
+@pytest.mark.timeout(60)
+def test_obs_timeline_flag_group_and_inapplicable_rejects():
+    """`--obs.timeline.*` parses as a nested group; setting a knob without
+    enabling steps, or under fit, dies with a pointer (the inapplicable-
+    flag convention)."""
+    from perceiver_io_tpu.observability import ObservabilityArgs
+    from perceiver_io_tpu.scripts.cli import build_dataclass, flag_specs
+    from perceiver_io_tpu.scripts.text import clm as clm_script
+
+    specs = flag_specs(ObservabilityArgs, "obs")
+    for flag in ("obs.timeline.steps", "obs.timeline.export",
+                 "obs.timeline.swap_gbps"):
+        assert flag in specs, flag
+    obs = build_dataclass(
+        ObservabilityArgs,
+        {"obs.timeline.steps": 64, "obs.timeline.swap_gbps": 32.0}, "obs",
+    )
+    assert obs.timeline.enabled and obs.timeline.swap_gbps == 32.0
+    assert not ObservabilityArgs().timeline.enabled
+    with pytest.raises(SystemExit, match="applies to the serve subcommand"):
+        clm_script.main([
+            "fit", "--data=synthetic", "--obs.timeline.steps=64",
+        ])
+
+
+@pytest.mark.timeout(60)
+def test_obs_kit_requires_steps_for_timeline_knobs(tmp_path):
+    from perceiver_io_tpu.observability import ObservabilityArgs
+    from perceiver_io_tpu.observability.timeline import TimelineArgs
+    from perceiver_io_tpu.scripts.cli import _obs_kit
+
+    kit = _obs_kit(ObservabilityArgs(), str(tmp_path))
+    assert kit["timeline"] is None and kit["timeline_export"] is None
+    kit = _obs_kit(
+        ObservabilityArgs(timeline=TimelineArgs(
+            steps=32, export=str(tmp_path / "tl.jsonl"))),
+        str(tmp_path),
+    )
+    assert kit["timeline"] is not None and kit["timeline"].cap == 32
+    assert kit["timeline_export"].endswith("tl.jsonl")
+    with pytest.raises(SystemExit, match="obs.timeline.steps"):
+        _obs_kit(
+            ObservabilityArgs(timeline=TimelineArgs(export="x.jsonl")),
+            str(tmp_path),
+        )
+    with pytest.raises(SystemExit, match="swap_gbps"):
+        _obs_kit(
+            ObservabilityArgs(timeline=TimelineArgs(steps=8, swap_gbps=0.0)),
+            str(tmp_path),
+        )
+
+
+# -- checked-in fixture (make timeline) --------------------------------------
+@pytest.mark.timeout(60)
+def test_fixture_renders_pinned_flight_deck():
+    """The checked-in fixture (tests/fixtures/timeline/, regenerated by
+    tests/fixtures/timeline/generate.py) renders byte-identically — the
+    `make timeline` target runs the same command."""
+    import os
+
+    from perceiver_io_tpu.observability.report import run_timeline
+
+    fx = os.path.join(os.path.dirname(__file__), "fixtures", "timeline")
+    text = run_timeline(
+        os.path.join(fx, "timeline.jsonl"),
+        os.path.join(fx, "events.jsonl"),
+        top=10,
+    )
+    with open(os.path.join(fx, "expected.txt")) as fh:
+        assert text == fh.read().rstrip("\n")
