@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident timeline slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident timeline slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench speculative spec-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -239,6 +239,27 @@ preempt-bench:
 	model = CausalLanguageModel(cfg); \
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'preemption': bench._bench_preemption(model, params, cfg)}, indent=2))"
+
+# speculative-decoding suite (docs/serving.md "Speculative decoding"):
+# truncated-stack self-draft + single batched verify — greedy token-
+# identity across dense/paged/int8/prefix-shared/chunked/mesh geometries,
+# compile-bound +2, burst TTFT/ITL telescoping, ensure_many atomicity,
+# kv.exhaust zero-leak, autotune pays/declines pins — CPU-fast, also tier-1
+speculative:
+	$(PY) -m pytest tests/ -q -m speculative --continue-on-collection-errors
+
+# speculative A/B at the dispatch-bound probe shape (docs/serving.md
+# "Speculative decoding"): the same greedy workload with speculation off
+# vs a self-draft geometry — tokens/s both ways, acceptance rate, tokens
+# per round, token-identity pin, plus the autotune pays/declines verdicts
+spec-bench:
+	$(PY) -c "import json, jax; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	cfg = bench._mk_config(bench.CPU_SHAPE); \
+	print(json.dumps({'speculative': bench._bench_speculative(None, None, cfg)}, indent=2))"
 
 # sharded serving-runtime suite (docs/serving.md "Sharded serving"):
 # 1-device byte parity, 8-virtual-device token parity across dense/paged/

@@ -21,6 +21,15 @@ crossing point across context lengths, and ``--emit-strategy PATH`` writes
 the same JSON artifact the strategy persistence layer consumes — so a
 scaling study doubles as a deployment's warmup measurement.
 
+With ``--speculation`` each boundary point additionally runs the
+speculative-decoding autotune probe (docs/serving.md "Speculative
+decoding") at its shape: the per-ctx verdict (``off`` or the winning
+``k<K>d<D>`` draft geometry), acceptance rate, and per-token timings land
+in the point and the registry, and the summary reports the speculation
+crossover — the first context length at which drafting stops paying
+(verify-lane FLOPs grow with the window; the fixed per-step cost they
+amortize does not).
+
 Usage::
 
     python examples/perf/decode_scaling.py                  # boundary, 1k->8k
@@ -60,6 +69,16 @@ def main() -> None:
         "vs the recompute path's full window)",
     )
     p.add_argument("--out", default=None, help="also append JSON lines here")
+    p.add_argument(
+        "--speculation", action="store_true",
+        help="also run the speculative-decoding autotune probe per context "
+        "length (boundary phase only): records the per-ctx verdict + "
+        "acceptance and reports the ctx at which drafting stops paying",
+    )
+    p.add_argument(
+        "--spec-candidates", nargs="+", default=["k4d1", "k8d1"],
+        help="draft geometries the per-ctx speculation probe measures",
+    )
     p.add_argument(
         "--emit-strategy", default=None,
         help="write the decode-strategy registry JSON artifact here (the "
@@ -162,6 +181,21 @@ def main() -> None:
             )
             point["chosen_strategy"] = chosen
             point["cached_over_recompute"] = point["speedup"]
+            if args.speculation:
+                # the same measure-once discipline for the speculation
+                # knob: the probe A/Bs each draft geometry against the
+                # plain one-token step at THIS shape and memoizes the
+                # verdict (off = drafting doesn't pay here)
+                verdict = strategy_mod.autotune_speculation(
+                    model, params,
+                    candidates=tuple(args.spec_candidates), force=True,
+                )
+                entry = strategy_mod.spec_entry(model) or {}
+                point["speculation"] = verdict
+                point["speculation_acceptance"] = entry.get(
+                    "acceptance", {}).get(verdict)
+                point["speculation_ms_per_token"] = entry.get(
+                    "timings_ms_per_token", {})
         rows.append(point)
         print(json.dumps(point), flush=True)
         if args.out:
@@ -173,22 +207,35 @@ def main() -> None:
               file=sys.stderr)
 
     if args.phase == "boundary":
-        print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup | chosen |")
-        print("|---|---|---|---|---|---|---|")
+        spec_col = " speculation |" if args.speculation else ""
+        print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup | chosen |" + spec_col)
+        print("|---|---|---|---|---|---|---|" + ("---|" if args.speculation else ""))
         for r in rows:
+            extra = f" {r['speculation']} |" if args.speculation else ""
             print(f"| {r['ctx']} | {r['cached_tokens_per_sec']} | "
                   f"{r['recompute_tokens_per_sec']} | {r['cached_ms_per_token']} | "
                   f"{r['recompute_ms_per_token']} | {r['speedup']}x | "
-                  f"{r['chosen_strategy']} |")
+                  f"{r['chosen_strategy']} |" + extra)
         # the cached/recompute crossing point: the first context length at
         # which the cached boundary step wins (None = recompute everywhere)
         crossover = next(
             (r["ctx"] for r in rows if r["chosen_strategy"] == "cached"), None
         )
-        print(json.dumps({
+        summary = {
             "crossover_ctx": crossover,
             "chosen_by_ctx": {str(r["ctx"]): r["chosen_strategy"] for r in rows},
-        }))
+        }
+        if args.speculation:
+            # the speculation crossover runs the OTHER way: drafting pays
+            # at small windows (per-step cost amortized over the burst)
+            # and stops once verify-lane FLOPs dominate
+            summary["speculation_by_ctx"] = {
+                str(r["ctx"]): r["speculation"] for r in rows
+            }
+            summary["speculation_stops_paying_ctx"] = next(
+                (r["ctx"] for r in rows if r["speculation"] == "off"), None
+            )
+        print(json.dumps(summary))
     else:
         print("\n| ctx | cached tok/s | recompute tok/s | cached ms/tok | recompute ms/tok | speedup |")
         print("|---|---|---|---|---|---|")

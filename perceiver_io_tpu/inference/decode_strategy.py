@@ -70,12 +70,28 @@ PAGED_KV_LAYOUTS = ("paged", "paged_int8")
 PREFIX_CACHE_MODES = ("auto", "on", "off")
 PREFIX_CACHE_CHOICES = ("on", "off")
 
+#: slot-engine self-draft speculative-decoding axis (docs/serving.md
+#: "Speculative decoding"): ``k{K}d{D}`` proposes K tokens per round from a
+#: D-layer truncated latent stack (full-model params, no second checkpoint)
+#: and verifies all K+1 positions in one batched forward — greedy output
+#: token-identical to the non-speculative step, so whether it PAYS is the
+#: same measured platform/shape property as every other axis here:
+#: acceptance rate × per-round cost vs K+1 plain steps. Draft depths past 2
+#: approach full-model cost and stop being drafts, so the measured grid
+#: stops there.
+SPECULATION_CHOICES = ("off",) + tuple(
+    f"k{k}d{d}" for d in (1, 2) for k in (2, 4, 8)
+)
+SPECULATION_MODES = ("auto",) + SPECULATION_CHOICES
+
 #: env var overriding the boundary-phase strategy process-wide
 ENV_VAR = "PERCEIVER_DECODE_STRATEGY"
 #: env var overriding the slot engine's KV layout process-wide
 ENV_KV_LAYOUT = "PERCEIVER_KV_LAYOUT"
 #: env var overriding the slot engine's prefix-cache mode process-wide
 ENV_PREFIX_CACHE = "PERCEIVER_PREFIX_CACHE"
+#: env var overriding the slot engine's speculation mode process-wide
+ENV_SPECULATION = "PERCEIVER_SPECULATION"
 #: env var pointing at a persisted strategy-registry JSON file
 ENV_FILE = "PERCEIVER_DECODE_STRATEGY_FILE"
 #: env var overriding the int8 quality-gate budget (max greedy logit
@@ -141,6 +157,8 @@ _REGISTRY: dict = {}
 _KV_REGISTRY: dict = {}
 #: same key space -> {"prefix_cache": "on"|"off", ...} measurement entry
 _PREFIX_REGISTRY: dict = {}
+#: same key space -> {"speculation": "off"|"k{K}d{D}", ...} measurement entry
+_SPEC_REGISTRY: dict = {}
 _FILE_LOADED: set = set()  # paths already merged into the registries
 
 
@@ -274,11 +292,71 @@ def resolve_prefix_cache(
     return mode
 
 
+def lookup_speculation(model, platform: Optional[str] = None) -> Optional[str]:
+    """Measured speculation winner for this shape/platform/env, or None."""
+    _maybe_load_env_file()
+    entry = _SPEC_REGISTRY.get(registry_key(model, platform))
+    return None if entry is None else entry["speculation"]
+
+
+def spec_entry(model, platform: Optional[str] = None) -> Optional[dict]:
+    """The full speculation registry entry (verdict + measurement metadata,
+    including the acceptance rate the autotuner observed), or None.
+    Read-only view for observability and the perf examples."""
+    _maybe_load_env_file()
+    entry = _SPEC_REGISTRY.get(registry_key(model, platform))
+    return None if entry is None else dict(entry)
+
+
+def record_speculation(model, speculation: str, *,
+                       platform: Optional[str] = None, **extra) -> dict:
+    """Store a speculation verdict (plus measurement metadata — acceptance
+    rate, per-token timings) for this shape/platform/env."""
+    if speculation not in SPECULATION_CHOICES:
+        raise ValueError(
+            f"speculation must be one of {SPECULATION_CHOICES}, "
+            f"got {speculation!r}"
+        )
+    entry = {"speculation": speculation, **extra}
+    _SPEC_REGISTRY[registry_key(model, platform)] = entry
+    return entry
+
+
+def resolve_speculation(
+    mode: Optional[str],
+    model=None,
+    *,
+    platform: Optional[str] = None,
+) -> str:
+    """Resolve a slot-engine speculation request into one of
+    :data:`SPECULATION_CHOICES` (docs/serving.md "Speculative decoding").
+
+    Order mirrors :func:`resolve_kv_layout`: explicit mode >
+    :data:`ENV_SPECULATION` > ``"auto"`` (registry lookup, falling back to
+    ``"off"`` — the status-quo one-token step — when nothing has been
+    measured). Speculation is greedy-only; the engine enforces that
+    pairing, not this resolver.
+    """
+    if mode is None:
+        mode = os.environ.get(ENV_SPECULATION) or "auto"
+    if mode not in SPECULATION_MODES:
+        raise ValueError(
+            f"speculation must be one of {SPECULATION_MODES}, got {mode!r}"
+        )
+    if mode == "auto":
+        measured = (
+            lookup_speculation(model, platform) if model is not None else None
+        )
+        return measured or "off"
+    return mode
+
+
 def reset_registry() -> None:
     """Test isolation: drop every memoized verdict and forget loaded files."""
     _REGISTRY.clear()
     _KV_REGISTRY.clear()
     _PREFIX_REGISTRY.clear()
+    _SPEC_REGISTRY.clear()
     _FILE_LOADED.clear()
 
 
@@ -314,16 +392,21 @@ def save_registry(path: str) -> None:
             _PREFIX_REGISTRY.items(), key=lambda kv: repr(kv[0])
         )
     ]
+    spec_entries = [
+        {"key": _key_to_json(key), **entry} for key, entry in sorted(
+            _SPEC_REGISTRY.items(), key=lambda kv: repr(kv[0])
+        )
+    ]
     tmp = path + ".tmp"
     dirpath = os.path.dirname(path)
     if dirpath:
         os.makedirs(dirpath, exist_ok=True)
     with open(tmp, "w") as fh:
-        # version stays 1: kv_entries / prefix_entries are additive and
-        # readers written before them simply ignore the keys
+        # version stays 1: kv_entries / prefix_entries / spec_entries are
+        # additive and readers written before them simply ignore the keys
         json.dump(
             {"version": 1, "entries": entries, "kv_entries": kv_entries,
-             "prefix_entries": prefix_entries},
+             "prefix_entries": prefix_entries, "spec_entries": spec_entries},
             fh, indent=2,
         )
     os.replace(tmp, path)
@@ -347,6 +430,7 @@ def load_registry(path: str) -> int:
         ("entries", _REGISTRY, "boundary", PHASE_CHOICES),
         ("kv_entries", _KV_REGISTRY, "kv_layout", KV_LAYOUT_CHOICES),
         ("prefix_entries", _PREFIX_REGISTRY, "prefix_cache", PREFIX_CACHE_CHOICES),
+        ("spec_entries", _SPEC_REGISTRY, "speculation", SPECULATION_CHOICES),
     ):
         entries = data.get(field)
         if not isinstance(entries, list):
@@ -690,6 +774,107 @@ def autotune_kv_layout(
         paged_int8_ms_per_token=round(timings["paged_int8"], 4),
         quant_gate=quality,
         slots=slots, block_size=block_size, new_tokens=new_tokens,
+    )
+    if persist:
+        save_registry(persist)
+    return winner
+
+
+#: acceptance-rate floor below which the speculation autotuner declines no
+#: matter the timing: at acceptance a, a k-token round emits ~1 + a·k
+#: tokens, so below ~0.5 the verify work is mostly thrown away and the
+#: measured "win" is noise at probe scale. Deterministic gate (a rate, not
+#: a clock), so FakeClock runs decline reproducibly.
+DEFAULT_SPEC_ACCEPT_FLOOR = 0.5
+
+
+def autotune_speculation(
+    model,
+    params,
+    *,
+    slots: int = 2,
+    new_tokens: int = 8,
+    candidates: tuple = ("k4d1",),
+    accept_floor: float = DEFAULT_SPEC_ACCEPT_FLOOR,
+    clock: Callable[[], float] = time.perf_counter,
+    persist: Optional[str] = None,
+    force: bool = False,
+) -> str:
+    """Measure self-draft speculation against the plain one-token step at
+    the bound shape and memoize the winner; returns one of
+    :data:`SPECULATION_CHOICES`.
+
+    The probe drives a tiny :class:`~perceiver_io_tpu.serving.slots.
+    SlotServingEngine` per arm over the shared KV-probe workload (same
+    prompts, greedy, EOS-free — and speculation is token-identical by
+    construction, so every arm emits the identical schedule): one pass to
+    compile, one timed pass, per-token ms on ``clock``. A speculative arm
+    must clear TWO gates to win: its measured acceptance rate must reach
+    ``accept_floor`` (the deterministic decline — drafts the model keeps
+    rejecting can never pay), and its per-token time must beat ``"off"``
+    strictly. Ties — including the all-zero durations an un-advanced
+    FakeClock produces — break toward ``"off"``, the status-quo step.
+    Candidates whose draft depth is not a strict truncation of the bound
+    model's stack are skipped (a full-depth "draft" is just the model).
+
+    :param persist: JSON path — merged before deciding (a persisted verdict
+        short-circuits the measurement unless ``force``) and rewritten
+        after, sharing the boundary registry's artifact file.
+    """
+    from perceiver_io_tpu.serving.slots import SlotServingEngine
+
+    if persist:
+        load_registry(persist)
+    _maybe_load_env_file()
+    key = registry_key(model)
+    if not force and key in _SPEC_REGISTRY:
+        return _SPEC_REGISTRY[key]["speculation"]
+
+    num_layers = int(model.config.num_self_attention_layers)
+    arms = ["off"]
+    skipped = []
+    for cand in candidates:
+        if cand not in SPECULATION_CHOICES or cand == "off":
+            raise ValueError(
+                f"candidates must come from {SPECULATION_CHOICES[1:]}, "
+                f"got {cand!r}"
+            )
+        draft_layers = int(cand.split("d")[1])
+        (arms if draft_layers < num_layers else skipped).append(cand)
+
+    table, gcfg, prompts, new_tokens = _kv_probe_workload(model, slots, new_tokens)
+
+    timings, acceptance = {}, {}
+    for arm in arms:
+        def make():
+            return SlotServingEngine(
+                model, params, gcfg, table, slots=slots, speculation=arm,
+            )
+
+        compile_engine = make()
+        compile_engine.serve(prompts)  # pays the per-arm executor builds
+        engine = make()
+        for p in prompts:
+            engine.submit(p)
+        t0 = clock()
+        engine.run_until_idle()
+        timings[arm] = (clock() - t0) / (slots * new_tokens) * 1e3
+        if arm != "off":
+            acceptance[arm] = engine.stats()["speculation"]["acceptance_rate"]
+
+    winner = "off"
+    for arm in arms[1:]:
+        if acceptance[arm] < accept_floor:
+            continue  # the deterministic decline: drafting isn't landing
+        if timings[arm] >= timings[winner if winner != "off" else "off"]:
+            continue
+        winner = arm
+    record_speculation(
+        model, winner,
+        timings_ms_per_token={a: round(t, 4) for a, t in timings.items()},
+        acceptance={a: round(r, 4) for a, r in acceptance.items()},
+        accept_floor=accept_floor, skipped=skipped,
+        slots=slots, new_tokens=new_tokens,
     )
     if persist:
         save_registry(persist)

@@ -95,7 +95,9 @@ def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     :param mdl: bound ``AutoregressiveSequenceModel``.
     :param window: ``(b, N)`` tokens, right-aligned, left pads arbitrary ids.
     :param pad_count: ``(b,)`` number of left-pad slots per row.
-    :param m: scalar — true latent count (last ``m`` window positions).
+    :param m: true latent count (last ``m`` window positions) — scalar, or
+        per-row ``(b,)`` (the speculative verify lanes give each row its own
+        post-candidate latent count; the scalar path is unchanged).
     """
     ar = mdl.perceiver_ar
     b, n = window.shape
@@ -111,8 +113,10 @@ def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     layer = ar.cross_attention
     ca = layer.cross_attn
     mha = ca.attention
+    m = jnp.asarray(m)
+    m_col = m[:, None] if m.ndim else m  # (b, 1) per-row or scalar
     is_latent = (jnp.arange(n) >= n - num_latents)[None, :] & (
-        jnp.arange(n)[None, :] >= n - m
+        jnp.arange(n)[None, :] >= n - m_col
     )
     x_q_all = ca.q_norm(emb)
     x_kv = jnp.where(is_latent[..., None], x_q_all, ca.kv_norm(emb))
@@ -130,7 +134,9 @@ def _decode_forward(mdl, window: jnp.ndarray, pad_count: jnp.ndarray, m: jnp.nda
     # are not yet real latents are masked as keys at every layer; the
     # reference passes no per-row pad mask to its stack (modules.py:730-733),
     # so none is added here either.
-    stack_pad = jnp.broadcast_to(jnp.arange(num_latents)[None, :] < num_latents - m, (b, num_latents))
+    stack_pad = jnp.broadcast_to(
+        jnp.arange(num_latents)[None, :] < num_latents - m_col, (b, num_latents)
+    )
     frq_latent = frq[:, -num_latents:]
     x = ar.self_attention(
         x, stack_pad, RotaryEmbedding(frq_latent, right_align=True), True

@@ -1,0 +1,381 @@
+"""Self-draft speculative decoding — k tokens per verified forward.
+
+The window-phase decode step (``generate.py`` phase 3, and the slot
+engine's recompute executors) pays one full-model forward per emitted
+token. This module trades that for a **draft/verify** round
+(PAPERS.md: speculative sampling; docs/serving.md "Speculative
+decoding"):
+
+- **Draft** (:func:`propose_tokens`): ``k`` candidate tokens from a
+  *truncated* latent stack — only the first ``draft_layers`` of the
+  model's self-attention layers run, on the full model's own parameters.
+  No second checkpoint, no distilled head: the Perceiver AR stack is the
+  draft model's prefix, so drafting costs roughly
+  ``draft_layers / num_layers`` of a step plus the (shared) cross-attend.
+- **Verify** (:func:`verify_lanes`): ONE batched full-model forward
+  scores all ``k + 1`` positions. Each of the ``k + 1`` *lanes* is
+  exactly the right-aligned window the non-speculative engine would have
+  seen after emitting the first ``j + 1`` candidates — same shift, same
+  pad clamp, same per-row latent count — stacked along the batch axis
+  into a single ``_decode_forward`` call. Exactness is by construction,
+  not by approximation: lane ``j``'s logits are bitwise the logits the
+  plain step would have produced, in *every* window regime (latent
+  growth, the ``m == max_latents`` boundary, mid-burst boundary
+  crossings, sliding window).
+- **Accept** (:func:`accept_prefix`): the longest prefix of drafted
+  tokens matching the verified greedy argmax is emitted —
+  ``n_e ∈ [1, k+1]`` tokens per round (the verified position after the
+  last match always emits, so a round never stalls). Greedy output is
+  therefore **token-identical** to the non-speculative step; speculation
+  only changes how many forwards buy those tokens.
+
+Greedy-only: acceptance compares argmaxes, so sampling
+(``do_sample=True``) or a non-unit repetition penalty (applied before
+argmax in ``sample_logits``) would break the identity — both are
+rejected loudly at validation time, never silently ignored.
+
+Whether a round PAYS is ``acceptance × k`` against ``k`` extra drafts +
+lane-widened verify — a platform/shape property measured by
+``decode_strategy.autotune_speculation`` and persisted beside the
+cached-vs-recompute, KV-layout, and prefix-cache axes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.inference.generate import GenerationConfig, _decode_forward
+from perceiver_io_tpu.inference.samplers import apply_min_new_tokens
+from perceiver_io_tpu.ops.position import RotaryEmbedding, positions
+
+_MODE_RE = re.compile(r"k(\d+)d(\d+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Static speculation geometry: ``k`` drafted tokens per round from a
+    ``draft_layers``-deep truncated stack. Both are compile-time constants
+    (the round's shapes depend on them), so they ride in executor cache
+    keys, never in traced state."""
+
+    k: int
+    draft_layers: int
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"speculation k must be >= 1, got {self.k}")
+        if self.draft_layers < 1:
+            raise ValueError(
+                f"draft_layers must be >= 1, got {self.draft_layers}"
+            )
+
+    @property
+    def mode(self) -> str:
+        return f"k{self.k}d{self.draft_layers}"
+
+
+def parse_speculation(mode: Optional[str]) -> Optional[SpecConfig]:
+    """``"off"``/None -> None; ``"k4d1"`` -> :class:`SpecConfig`(4, 1)."""
+    if mode is None or mode == "off":
+        return None
+    match = _MODE_RE.fullmatch(mode)
+    if match is None:
+        raise ValueError(
+            f"speculation mode must be 'off' or 'k<K>d<D>', got {mode!r}"
+        )
+    return SpecConfig(int(match.group(1)), int(match.group(2)))
+
+
+def validate_spec(spec: SpecConfig, model, config: GenerationConfig) -> None:
+    """Reject geometries/configs where the token-identity guarantee cannot
+    hold — loudly, at build time (a silent fallback would let an operator
+    believe they are measuring speculation when they are not)."""
+    num_layers = int(model.config.num_self_attention_layers)
+    if spec.draft_layers > num_layers:
+        raise ValueError(
+            f"draft_layers={spec.draft_layers} exceeds the model's "
+            f"{num_layers}-layer stack; the draft must be a truncation"
+        )
+    if config.num_beams > 1:
+        raise ValueError("speculation is greedy-only; num_beams must be 1")
+    if config.sampling.do_sample:
+        raise ValueError(
+            "speculation is greedy-only: acceptance compares argmaxes, so "
+            "do_sample=True cannot be token-identical — disable one of them"
+        )
+    if float(config.sampling.repetition_penalty) != 1.0:
+        raise ValueError(
+            "speculation requires repetition_penalty == 1.0: the greedy "
+            "sampler applies the penalty before argmax, which the verify "
+            "lanes do not model"
+        )
+
+
+def draft_forward(mdl, window, pad_count, m, draft_layers: int):
+    """Truncated-stack forward: the :func:`~perceiver_io_tpu.inference.
+    generate._decode_forward` prologue (embedding, boundary-normalized
+    cross-attention) followed by only the first ``draft_layers``
+    self-attention layers (first-layer-rotary semantics preserved, same
+    manual loop as ``_latent_stack_capture``), then the output head.
+
+    With ``draft_layers == num_self_attention_layers`` this IS the full
+    forward (the probe benches rely on that: acceptance is exactly 1.0);
+    shallower drafts trade acceptance for per-draft cost.
+    """
+    ar = mdl.perceiver_ar
+    b, n = window.shape
+    num_latents = mdl.max_latents
+
+    pad_mask = jnp.arange(n)[None, :] < pad_count[:, None]
+    abs_pos = positions(b, n, shift=pad_count[:, None])
+    emb, frq = ar.input_adapter(window, abs_pos=abs_pos)
+
+    layer = ar.cross_attention
+    ca = layer.cross_attn
+    mha = ca.attention
+    m = jnp.asarray(m)
+    m_col = m[:, None] if m.ndim else m
+    is_latent = (jnp.arange(n) >= n - num_latents)[None, :] & (
+        jnp.arange(n)[None, :] >= n - m_col
+    )
+    x_q_all = ca.q_norm(emb)
+    x_kv = jnp.where(is_latent[..., None], x_q_all, ca.kv_norm(emb))
+    x_q = x_q_all[:, -num_latents:]
+    q = mha.project_q(x_q, RotaryEmbedding(frq, right_align=True))
+    k, v = mha.project_kv(x_kv, RotaryEmbedding(frq, right_align=True))
+    attn = mha.attend(q, k, v, pad_mask=pad_mask, deterministic=True)
+    x = attn + emb[:, -num_latents:]
+    x = layer.mlp(x) + x
+
+    stack_pad = jnp.broadcast_to(
+        jnp.arange(num_latents)[None, :] < num_latents - m_col, (b, num_latents)
+    )
+    rot_latent = RotaryEmbedding(frq[:, -num_latents:], right_align=True)
+    for i, sa_layer in enumerate(ar.self_attention.layers[:draft_layers]):
+        sa = sa_layer.self_attn
+        r = rot_latent if (i == 0 or ar.self_attention.rotary_all_layers) else None
+        normed = sa.norm(x)
+        q_s = sa.attention.project_q(normed, r)
+        k_s, v_s = sa.attention.project_kv(normed, r)
+        attn = sa.attention.attend(
+            q_s, k_s, v_s, pad_mask=stack_pad, deterministic=True
+        )
+        x = attn + x
+        x = sa_layer.mlp(x) + x
+
+    x_last = x[:, -1]
+    if mdl.config.output_norm:
+        x_last = mdl.out_norm(x_last)
+    return mdl.output_adapter(x_last[:, None], ar.input_adapter.embeddings)[:, 0]
+
+
+def propose_tokens(
+    mdl, window, pad_count, m, steps, logits,
+    k: int, draft_layers: int, min_new: int, eos_token_id: int,
+):
+    """Draft phase: ``(b, k+1)`` candidates. ``cand[:, 0]`` is *exact* — the
+    greedy token of the already-verified ``logits`` (the same min-new-EOS
+    suppression and float32 argmax the plain step applies). ``cand[:, 1:]``
+    come from ``k`` truncated-stack steps, each advancing the window one
+    shift as the real step would, so drafted positions see the geometry
+    (pad clamp, latent growth) verification will re-check."""
+    num_latents = mdl.max_latents
+    tok = jnp.argmax(
+        apply_min_new_tokens(
+            logits.astype(jnp.float32), steps[:, None], min_new, eos_token_id
+        ),
+        axis=-1,
+    ).astype(window.dtype)
+    cand = [tok]
+    w, p, mm, st = window, pad_count, m, steps
+    for _ in range(k):
+        w = jnp.concatenate([w[:, 1:], tok[:, None]], axis=1)
+        p = jnp.maximum(p - 1, 0)
+        mm = jnp.minimum(mm + 1, num_latents)
+        st = st + 1
+        dlogits = draft_forward(mdl, w, p, mm, draft_layers).astype(jnp.float32)
+        tok = jnp.argmax(
+            apply_min_new_tokens(dlogits, st[:, None], min_new, eos_token_id),
+            axis=-1,
+        ).astype(window.dtype)
+        cand.append(tok)
+    return jnp.stack(cand, axis=1)
+
+
+def verify_lanes(mdl, window, pad_count, m, cand):
+    """Verify phase: ONE full-model forward over the ``k+1`` lanes.
+
+    Lane ``j`` (``j ∈ [0, k]``) reconstructs the exact state the plain
+    engine would hold after emitting ``cand[:, :j+1]``: window
+    ``ext[:, j+1 : j+1+n]`` (``ext`` = window ‖ candidates), pad
+    ``max(pad - (j+1), 0)``, latent count ``min(m + j + 1, max_latents)``.
+    Lanes stack along batch into a ``(b·(k+1), n)`` call — a single
+    fixed-shape dispatch whose row ``b·j`` logits are bitwise what the
+    ``j``-th sequential step would have produced, in every phase regime.
+
+    :return: ``(b, k+1, vocab)`` lane logits (raw model dtype).
+    """
+    b, n = window.shape
+    k1 = cand.shape[1]
+    num_latents = mdl.max_latents
+
+    ext = jnp.concatenate([window, cand.astype(window.dtype)], axis=1)
+    lanes = jnp.stack([ext[:, j + 1 : j + 1 + n] for j in range(k1)], axis=1)
+    offs = jnp.arange(1, k1 + 1, dtype=jnp.int32)
+    lane_pad = jnp.maximum(pad_count[:, None] - offs[None, :], 0)
+    m_b = jnp.broadcast_to(jnp.asarray(m), (b,))
+    lane_m = jnp.minimum(m_b[:, None] + offs[None, :], num_latents)
+    lane_logits = _decode_forward(
+        mdl,
+        lanes.reshape(b * k1, n),
+        lane_pad.reshape(b * k1).astype(pad_count.dtype),
+        lane_m.reshape(b * k1),
+    )
+    return lane_logits.reshape(b, k1, -1)
+
+
+def accept_prefix(lane_logits, cand, steps, min_new: int, eos_token_id: int):
+    """Accept phase (pure jnp, shared by the engine executor and the
+    standalone loop): longest matching drafted prefix + the logits that
+    seed the next round.
+
+    ``cand[:, j+1]`` is accepted iff it equals the verified greedy token of
+    lane ``j`` (float32, min-new suppression at the step count the plain
+    engine would have used) *and* every earlier draft matched — the
+    cumulative product. ``n_e = 1 + accepted ∈ [1, k+1]``; the next-round
+    logits are lane ``n_e - 1``'s, raw (suppression is re-applied at
+    sampling time, exactly like the plain step's stored logits).
+
+    :return: ``(n_e (b,) int32, next_logits (b, vocab))``
+    """
+    b, k1, vocab = lane_logits.shape
+    k = k1 - 1
+    if k > 0:
+        st = steps[:, None] + jnp.arange(1, k + 1, dtype=jnp.int32)[None, :]
+        masked = apply_min_new_tokens(
+            lane_logits[:, :k].astype(jnp.float32).reshape(b * k, vocab),
+            st.reshape(b * k, 1),
+            min_new,
+            eos_token_id,
+        )
+        pred = jnp.argmax(masked, axis=-1).reshape(b, k).astype(cand.dtype)
+        match = (cand[:, 1:] == pred).astype(jnp.int32)
+        n_e = 1 + jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    else:
+        n_e = jnp.ones((b,), jnp.int32)
+    next_logits = jnp.take_along_axis(
+        lane_logits, (n_e - 1)[:, None, None], axis=1
+    )[:, 0]
+    return n_e.astype(jnp.int32), next_logits
+
+
+def advance_window(window, pad_count, m, cand, n_e, num_latents: int):
+    """Advance the right-aligned window state past ``n_e`` accepted tokens —
+    the burst form of the plain step's shift-by-one: new window
+    ``ext[:, n_e : n_e+n]`` per row, pad/latent clamps applied exactly as
+    ``n_e`` sequential steps would have.
+
+    :return: ``(window, pad_count, m)`` advanced.
+    """
+    n = window.shape[1]
+    ext = jnp.concatenate([window, cand.astype(window.dtype)], axis=1)
+    idx = n_e[:, None] + jnp.arange(n)[None, :]
+    new_window = jnp.take_along_axis(ext, idx, axis=1)
+    new_pad = jnp.maximum(pad_count - n_e, 0)
+    new_m = jnp.minimum(jnp.asarray(m) + n_e, num_latents)
+    return new_window, new_pad, new_m
+
+
+def speculative_generate(
+    model,
+    params,
+    input_ids: jnp.ndarray,
+    config: GenerationConfig,
+    spec: SpecConfig,
+    *,
+    prompt_pad_count: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Greedy generation through draft/verify rounds — the standalone
+    (engine-free) loop, token-identical to :func:`~perceiver_io_tpu.
+    inference.generate.generate` by the lane construction.
+
+    Host-looped (one jitted round function, reused across rounds) rather
+    than scanned: ``n_e`` is data-dependent, and the host owns EOS/
+    ``max_new_tokens`` truncation mid-burst just as the slot engine does.
+
+    :return: ``(b, max_new_tokens)`` generated ids (pad after EOS) — the
+        same contract as ``generate()``.
+    """
+    validate_spec(spec, model, config)
+    b, prompt_len = input_ids.shape
+    n = model.max_seq_len
+    max_latents = model.max_latents
+    if not 0 < prompt_len <= n:
+        raise ValueError(f"prompt length out of valid range [1..{n}]")
+    num_latents = min(prompt_len, config.num_latents)
+    if prompt_pad_count is None:
+        prompt_pad_count = jnp.zeros((b,), jnp.int32)
+
+    min_new = config.min_new_tokens if config.eos_token_id is not None else 0
+    eos = config.eos_token_id if config.eos_token_id is not None else 0
+    window = jnp.concatenate(
+        [
+            jnp.full((b, n - prompt_len), config.pad_token_id, input_ids.dtype),
+            input_ids,
+        ],
+        axis=1,
+    ) if prompt_len < n else input_ids
+    pad = jnp.asarray(n - prompt_len + prompt_pad_count, jnp.int32)
+    m = jnp.full((b,), num_latents, jnp.int32)
+    steps = jnp.zeros((b,), jnp.int32)
+
+    def prefill(p, w, pc, mm):
+        return model.apply({"params": p}, w, pc, mm, method=_decode_forward)
+
+    def round_fn(p, w, pc, mm, st, lo):
+        cand = model.apply(
+            {"params": p}, w, pc, mm, st, lo,
+            spec.k, spec.draft_layers, min_new, eos,
+            method=propose_tokens,
+        )
+        lane_logits = model.apply(
+            {"params": p}, w, pc, mm, cand, method=verify_lanes
+        )
+        n_e, next_logits = accept_prefix(lane_logits, cand, st, min_new, eos)
+        new_w, new_pc, new_mm = advance_window(w, pc, mm, cand, n_e, max_latents)
+        return cand, n_e, new_w, new_pc, new_mm, st + n_e, next_logits
+
+    prefill_jit = jax.jit(prefill)
+    round_jit = jax.jit(round_fn)
+
+    logits = prefill_jit(params, window, pad, m)
+    emitted = [[] for _ in range(b)]
+    done = [False] * b
+    while not all(done):
+        cand, n_e, window, pad, m, steps, logits = round_jit(
+            params, window, pad, m, steps, logits
+        )
+        cand_np = np.asarray(jax.device_get(cand))
+        n_e_np = np.asarray(jax.device_get(n_e))
+        for row in range(b):
+            if done[row]:
+                continue
+            for j in range(int(n_e_np[row])):
+                token = int(cand_np[row, j])
+                emitted[row].append(token)
+                if (
+                    config.eos_token_id is not None
+                    and token == config.eos_token_id
+                ) or len(emitted[row]) >= config.max_new_tokens:
+                    done[row] = True
+                    break
+
+    out = np.full((b, config.max_new_tokens), config.pad_token_id, np.int32)
+    for row in range(b):
+        out[row, : len(emitted[row])] = emitted[row]
+    return jnp.asarray(out)

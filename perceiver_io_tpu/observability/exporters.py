@@ -106,6 +106,10 @@ HELP_TEXT = {
     "kv_preemptions_total": "Residents preempted under pool pressure: pages returned, request requeued for recompute-from-prompt replay (docs/serving.md \"Preemption & priorities\").",
     "kv_readmissions_total": "Previously preempted requests readmitted to a slot (each eventually completing token-identically).",
     "kv_pool_headroom_blocks": "Free pool blocks beyond the sum of live reservations — the lazy-admission safety margin; 0 means the next boundary crossing may preempt.",
+    "spec_rounds_total": "Speculative draft+verify rounds executed (one fixed-shape round per scheduler pass with speculation on; docs/serving.md \"Speculative decoding\").",
+    "spec_tokens_proposed_total": "Draft tokens proposed by the truncated-stack self-draft head (k per active row per round).",
+    "spec_tokens_accepted_total": "Draft tokens accepted by the batched verify pass (longest matching prefix; acceptance = accepted / proposed).",
+    "spec_tokens_emitted_total": "Tokens emitted by speculative rounds (accepted drafts + the verify pass's own token per row).",
     "executor_resident_bytes": "Sum of recorded executors' temp+output bytes (XLA memory analysis).",
     "trainer_steps_total": "Executed optimizer steps (skipped steps included).",
     "trainer_skipped_steps_total": "Steps discarded by the non-finite skip policy.",

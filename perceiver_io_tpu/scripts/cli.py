@@ -291,6 +291,17 @@ class ServeArgs:
     #: PERCEIVER_PREFIX_CACHE then the measured registry (off when
     #: unrecorded). ``on`` requires --serve.kv_layout=paged.
     prefix_cache: str = "auto"
+    #: self-draft speculative decoding for the slot engine (docs/serving.md
+    #: "Speculative decoding"): ``k<K>d<D>`` drafts K candidate tokens per
+    #: step with a D-layer truncated latent stack (same checkpoint, no
+    #: second model) and verifies all K+1 positions in ONE batched forward
+    #: — greedy output stays token-identical to ``off``; throughput
+    #: improves when acceptance is high enough that multi-token steps beat
+    #: one-token steps. ``auto`` defers to PERCEIVER_SPECULATION, then
+    #: measures acceptance x per-step cost at warmup and memoizes the
+    #: verdict (falls back to ``off`` when drafting doesn't pay).
+    #: Greedy-only: sampling/beams/repetition-penalty reject loudly.
+    speculation: str = "auto"
     #: preemption mode for the paged slot engine (docs/serving.md
     #: "Preemption & priorities"): ``recompute`` switches admission to
     #: optimistic lazy paging — requests admit when their PROMPT pages
@@ -430,6 +441,34 @@ def _serve_prefix_cache(flag_value: str) -> str:
         raise SystemExit(
             f"{strategy_mod.ENV_PREFIX_CACHE} must be one of "
             f"{'|'.join(strategy_mod.PREFIX_CACHE_MODES)}, got {env_mode!r}"
+        )
+    return env_mode
+
+
+def _serve_speculation(flag_value: str) -> str:
+    """Resolve ``--serve.speculation`` against ``PERCEIVER_SPECULATION`` —
+    the same deference rules as :func:`_serve_kv_layout`: an explicit
+    ``off``/``k<K>d<D>`` flag beats the env var; the ``auto`` default
+    defers to it (then to the measured registry at engine construction,
+    with an acceptance-probe autotune at warmup when unrecorded)."""
+    import os
+
+    from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+
+    if flag_value not in strategy_mod.SPECULATION_MODES:
+        raise SystemExit(
+            "--serve.speculation must be one of "
+            f"{'|'.join(strategy_mod.SPECULATION_MODES)}, got {flag_value!r}"
+        )
+    if flag_value != "auto":
+        return flag_value
+    env_mode = os.environ.get(strategy_mod.ENV_SPECULATION)
+    if not env_mode:
+        return flag_value
+    if env_mode not in strategy_mod.SPECULATION_MODES:
+        raise SystemExit(
+            f"{strategy_mod.ENV_SPECULATION} must be one of "
+            f"{'|'.join(strategy_mod.SPECULATION_MODES)}, got {env_mode!r}"
         )
     return env_mode
 
@@ -1224,6 +1263,25 @@ class CLI:
             )
             kv_mode = _serve_kv_layout(args.kv_layout)
             prefix_mode = _serve_prefix_cache(args.prefix_cache)
+            spec_mode = _serve_speculation(args.speculation)
+            if (
+                args.engine == "slots"
+                and args.warmup
+                and spec_mode == "auto"
+                and strategy_mod.lookup_speculation(model) is None
+            ):
+                # measure once, memoize (docs/serving.md "Speculative
+                # decoding"): A/B each draft geometry against "off" on the
+                # probe workload and record acceptance x per-step cost; the
+                # verdict lands in the strategy registry so a persisted
+                # --serve.decode_strategy_file skips this on the next boot
+                t0 = time.monotonic()
+                spec_mode = strategy_mod.autotune_speculation(model, params)
+                print(
+                    f"[serve] speculation autotune picked {spec_mode!r} in "
+                    f"{time.monotonic() - t0:.1f}s", file=sys.stderr,
+                    flush=True,
+                )
             flight_recorder = kit["flight_recorder"]
             # sharded serving (docs/serving.md "Sharded serving"): any
             # --serve.mesh.* flag opts in — including an explicit 1x1
@@ -1268,6 +1326,7 @@ class CLI:
                         kv_blocks=args.kv_blocks, prefix_cache=prefix_mode,
                         preemption=args.preemption,
                         admit_headroom_blocks=args.admit_headroom_blocks,
+                        speculation=spec_mode,
                         mesh=(
                             mesh_alloc.acquire() if mesh_alloc is not None
                             else None
@@ -1315,6 +1374,12 @@ class CLI:
                         "apply to --serve.engine=slots with a paged KV "
                         "layout (the bucket engine has no page pool to "
                         "preempt from)"
+                    )
+                if args.speculation != "auto":
+                    raise SystemExit(
+                        "--serve.speculation applies to --serve.engine=slots "
+                        "(the bucket engine has no resident decode loop to "
+                        "draft ahead of)"
                     )
 
                 def make_engine():
@@ -1429,7 +1494,9 @@ class CLI:
                 )
                 if args.decode_strategy_file and (
                     decode_mode == "auto"
-                    or (args.engine == "slots" and kv_mode == "auto")
+                    or (args.engine == "slots" and (
+                        kv_mode == "auto" or args.speculation == "auto"
+                    ))
                 ):
                     strategy_mod.save_registry(args.decode_strategy_file)
 
@@ -1675,6 +1742,7 @@ class CLI:
               "--serve.engine={bucket|slots} --serve.slots --serve.prefill_chunk "
               "--serve.decode_strategy={auto|cached|recompute} "
               "--serve.decode_strategy_file "
+              "--serve.speculation={auto|off|k<K>d<D>} "
               "--serve.prompt_buckets --serve.batch_buckets --serve.warmup "
               "--serve.max_queue --serve.deadline_s "
               "--serve.replicas=<n> --serve.failover={true|false} "

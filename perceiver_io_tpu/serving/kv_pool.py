@@ -412,6 +412,48 @@ class KVPagePool:
             self.high_water = max(self.high_water, self.in_use)
         return changed
 
+    def ensure_many(self, slot: int, tokens: int) -> bool:
+        """Burst form of :meth:`ensure` — ATOMIC over a multi-block span.
+
+        A speculative round can accept up to ``k+1`` tokens at once, so one
+        call may need to map several fresh blocks. :meth:`ensure` maps
+        page-by-page and checks the lazy-slot free-heap guard per page:
+        correct for the one-crossing-per-step decode path, but a burst
+        hitting exhaustion mid-span would leave the LEADING pages mapped —
+        a partial mapping the preempt-and-retry loop would then double
+        count. This wrapper pre-checks the WHOLE span against the
+        unreserved free heap (reservation-consuming pages keep
+        :attr:`headroom_blocks` unchanged, so lazy pages alone spend it)
+        and only then delegates — on :class:`PoolExhausted` the slot table
+        is untouched, and the block-id sequence is identical to ``n``
+        single :meth:`ensure` calls (same min-heap order)."""
+        pages = self.blocks_needed(tokens)
+        mapped = self._mapped[slot]
+        new_pages = max(0, pages - len(mapped))
+        if new_pages == 0:
+            return False
+        soft = self._soft.get(slot)
+        if soft is not None and pages > soft:
+            raise ValueError(
+                f"slot {slot} needs {pages} pages past its soft watermark "
+                f"{soft} — admission accounting bug"
+            )
+        lazy_pages = max(0, new_pages - self._reserved[slot])
+        if lazy_pages:
+            if soft is None:
+                raise ValueError(
+                    f"slot {slot} mapping {lazy_pages} pages past its "
+                    "reservation — admission accounting bug"
+                )
+            if lazy_pages > self.headroom_blocks:
+                raise PoolExhausted(
+                    f"slot {slot} needs {lazy_pages} unreserved free blocks "
+                    f"for a {new_pages}-page burst but only "
+                    f"{self.headroom_blocks} remain — preempt a victim to "
+                    "continue"
+                )
+        return self.ensure(slot, tokens)
+
     def release(self, slot: int, cause: str = "retire") -> int:
         """Deref ``slot``'s mapped blocks and drop its unconsumed
         reservation (retire/cancel/failover/timeout all route here);

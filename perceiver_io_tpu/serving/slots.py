@@ -71,12 +71,33 @@ exact, so greedy output stays token-identical either way; ``"auto"`` uses
 the autotuner's memoized verdict (``warmup()`` measures it once when asked
 explicitly).
 
+**Speculative decoding** (``speculation="k<K>d<D>"`` /
+``PERCEIVER_SPECULATION``; docs/serving.md "Speculative decoding"): a
+self-draft proposer (the model's own first ``D`` self-attention layers,
+``inference/speculative.py``) drafts ``K`` tokens per round and ONE
+fixed-shape lane-batched verify forward scores all ``K+1`` positions; the
+longest matching drafted prefix — ``n_e ∈ [1, K+1]`` tokens — advances
+the persistent state in a single step. Greedy output stays
+token-identical by the lane construction (each lane IS the window the
+plain step would have seen), so speculation composes with every KV axis:
+the verify executors pass the dense/paged/int8 caches through untouched
+(recompute lanes never read them past the prefill), the pool maps each
+round's worst-case burst atomically (``kv_pool.ensure_many`` —
+multi-block crossings, lazy admission, and preemption victims behave as
+``n_e`` sequential steps would), and every accepted token gets its own
+``on_token`` delivery, ITL sample, and timeline event in index order.
+Whether a round PAYS is measured
+(``decode_strategy.autotune_speculation``) and persisted beside the
+boundary/KV-layout/prefix-cache verdicts; ``"off"`` is byte-identical to
+the pre-speculation engine.
+
 Compile-count guarantee: at most ``len(prompt_buckets)`` prefill executors
 plus one decode executor plus its boundary variant, plus ONE chunked-
-prefill executor when ``prefill_chunk`` is set (``+2 -> +3``) —
+prefill executor when ``prefill_chunk`` is set (``+2 -> +3``), plus the
+draft + verify executor pair when ``speculation`` is on (``+2``) —
 mixed-length traffic causes **zero** additional retraces after
 :meth:`SlotServingEngine.warmup` (pinned by ``tests/test_slots.py`` /
-``tests/test_decode_strategy.py``).
+``tests/test_decode_strategy.py`` / ``tests/test_speculative.py``).
 
 Exactness: for greedy decoding the slot engine is token-identical to
 unbucketed per-request ``generate()``, including requests admitted into
@@ -109,6 +130,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from perceiver_io_tpu.inference import decode_strategy as decode_strategy_mod
+from perceiver_io_tpu.inference import speculative as speculative_mod
 from perceiver_io_tpu.inference.generate import (
     GenerationConfig,
     _decode_forward,
@@ -705,6 +727,75 @@ def _build_decode_executor(model, config: GenerationConfig, boundary: bool,
     return _jit(run, _donate(1), out_shardings)
 
 
+def _build_spec_draft_executor(model, config: GenerationConfig, spec,
+                               out_shardings=None):
+    """Draft phase of one speculative round (docs/serving.md "Speculative
+    decoding"): ``spec.k`` truncated-stack forwards propose ``(slots, k+1)``
+    candidate tokens from the resident window/logits state —
+    ``cand[:, 0]`` is the exact greedy token of the already-verified
+    logits, the rest come from the ``spec.draft_layers``-deep self-draft
+    (:func:`~perceiver_io_tpu.inference.speculative.propose_tokens`).
+    Read-only over the state (NO donation — the verify executor consumes
+    the same buffers right after), so the pair costs no extra state copy."""
+    min_new = config.min_new_tokens if config.eos_token_id is not None else 0
+
+    def run(params, state):
+        return model.apply(
+            {"params": params}, state["window"], state["pad"], state["m"],
+            state["steps"], state["logits"], spec.k, spec.draft_layers,
+            min_new, config.eos_token_id or 0,
+            method=speculative_mod.propose_tokens,
+        )
+
+    return _jit(run, (), out_shardings)
+
+
+def _build_spec_verify_executor(model, config: GenerationConfig, spec,
+                                out_shardings=None):
+    """Verify + accept + advance phase of one speculative round: ONE
+    lane-batched full-model forward scores all ``k+1`` candidate positions
+    (:func:`~perceiver_io_tpu.inference.speculative.verify_lanes` — lane
+    ``j`` is bitwise the window the plain step would have seen after
+    emitting ``j+1`` tokens, per row, in every phase regime), the longest
+    matching drafted prefix is accepted, and the fixed-shape state
+    advances by ``n_e ∈ [1, k+1]`` tokens in one donated step.
+
+    The KV caches (dense cross or paged pool + scales, latent stacks) pass
+    through UNTOUCHED: speculation decodes by windowed recompute, so cache
+    content past what the prefill wrote is never read again — the same
+    deliberate-staleness contract as the recompute boundary strategy, and
+    the reason speculation composes with paged/int8/prefix-shared layouts
+    without a cache-append variant per layout."""
+    n = model.max_seq_len
+    max_latents = model.max_latents
+    min_new = config.min_new_tokens if config.eos_token_id is not None else 0
+
+    def run(params, state, cand):
+        lane_logits = model.apply(
+            {"params": params}, state["window"], state["pad"], state["m"],
+            cand, method=speculative_mod.verify_lanes,
+        )
+        n_e, next_logits = speculative_mod.accept_prefix(
+            lane_logits, cand, state["steps"], min_new,
+            config.eos_token_id or 0,
+        )
+        window, pad, m = speculative_mod.advance_window(
+            state["window"], state["pad"], state["m"], cand, n_e, max_latents
+        )
+        new_state = dict(state)
+        new_state.update(
+            window=window,
+            pad=pad,
+            length=jnp.minimum(state["length"] + n_e, n),  # idle slots saturate
+            m=m,
+            steps=state["steps"] + n_e,
+            logits=next_logits.astype(state["logits"].dtype),
+        )
+        return new_state, n_e
+
+    return _jit(run, _donate(1), out_shardings)
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side record of one resident request: the emitted tokens plus the
@@ -869,6 +960,7 @@ class SlotServingEngine(ServingEngine):
                  preemption: Optional[str] = None,
                  admit_headroom_blocks: int = 0,
                  swap_link_gbps: float = 16.0,
+                 speculation: Optional[str] = None,
                  mesh=None, **kwargs):
         super().__init__(
             model, params, config, table, decode_strategy=decode_strategy,
@@ -928,6 +1020,10 @@ class SlotServingEngine(ServingEngine):
             "kv_ragged_kernel_steps_total",
             "kv_preemptions_total",
             "kv_readmissions_total",
+            "spec_rounds_total",
+            "spec_tokens_proposed_total",
+            "spec_tokens_accepted_total",
+            "spec_tokens_emitted_total",
         )
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._admitting: Optional[_ChunkedAdmit] = None
@@ -1010,6 +1106,27 @@ class SlotServingEngine(ServingEngine):
                 "pages need kv_layout='paged' (or 'paged_int8'; dense slots "
                 "reserve their worst case by construction)"
             )
+        # -- speculative decoding (docs/serving.md "Speculative decoding") -
+        # draft/verify bursts are a DECODE property orthogonal to the KV
+        # axes: exactness comes from the recompute lanes, so speculation
+        # composes with dense, paged, int8, prefix sharing, and preemption
+        # alike. Resolution mirrors the other measured axes: explicit arg >
+        # PERCEIVER_SPECULATION > measured registry > off; the geometry is
+        # validated HERE (greedy-only, draft a strict truncation) so a
+        # misconfigured operator fails at construction, never mid-serving.
+        if speculation is not None and \
+                speculation not in decode_strategy_mod.SPECULATION_MODES:
+            raise ValueError(
+                "speculation must be one of "
+                f"{decode_strategy_mod.SPECULATION_MODES}, got {speculation!r}"
+            )
+        self.speculation_requested = speculation
+        self.speculation = decode_strategy_mod.resolve_speculation(
+            speculation, model
+        )
+        self._spec = speculative_mod.parse_speculation(self.speculation)
+        if self._spec is not None:
+            speculative_mod.validate_spec(self._spec, model, self.config)
         if swap_link_gbps <= 0:
             raise ValueError(
                 f"swap_link_gbps must be > 0, got {swap_link_gbps}"
@@ -1446,6 +1563,43 @@ class SlotServingEngine(ServingEngine):
             ledger_site="slot_decode",
             ledger_components=lambda: self._ledger_components(
                 boundary=boundary, decode_strategy=mode
+            ),
+        )
+
+    def _spec_cand_sharding(self):
+        """Sharding for the draft executor's ``(slots, k+1)`` candidate
+        block: slots along ``data`` like every per-row state leaf."""
+        if self.sharding is None:
+            return None
+        return self.sharding.leaf_sharding(
+            "window", (self.slots, self._spec.k + 1)
+        )
+
+    def _spec_draft_executor(self):
+        spec = self._spec
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("spec_draft", spec.mode),
+            lambda: _build_spec_draft_executor(
+                self.model, self.config, spec,
+                out_shardings=self._spec_cand_sharding(),
+            ),
+            ledger_site="spec_draft",
+            ledger_components=lambda: self._ledger_components(
+                speculation=spec.mode
+            ),
+        )
+
+    def _spec_verify_executor(self):
+        spec = self._spec
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("spec_verify", spec.mode),
+            lambda: _build_spec_verify_executor(
+                self.model, self.config, spec,
+                out_shardings=self._decode_out_shardings(),
+            ),
+            ledger_site="spec_verify",
+            ledger_components=lambda: self._ledger_components(
+                speculation=spec.mode
             ),
         )
 
@@ -2737,7 +2891,18 @@ class SlotServingEngine(ServingEngine):
                 for entry in active:
                     if self._slots[entry.slot] is not entry:
                         continue  # preempted as an earlier row's victim
-                    next_len = int(entry.req.prompt.size) + len(entry.emitted) + 1
+                    # speculative bursts map this round's WORST-CASE accepted
+                    # span up front (atomically — ensure_many), so a
+                    # mid-burst boundary crossing can never strand a
+                    # half-mapped row; clamped to the request's remaining
+                    # budget so a retiring row maps nothing it cannot emit
+                    burst = 1 if self._spec is None else max(
+                        1, min(self._spec.k + 1,
+                               entry.max_new - len(entry.emitted))
+                    )
+                    next_len = (
+                        int(entry.req.prompt.size) + len(entry.emitted) + burst
+                    )
                     while True:
                         try:
                             if forced is not None and forced.kind == "error":
@@ -2745,7 +2910,12 @@ class SlotServingEngine(ServingEngine):
                                 raise PoolExhausted(
                                     "chaos: kv.exhaust scripted pool pressure"
                                 )
-                            changed |= self._pool.ensure(entry.slot, next_len)
+                            if burst > 1:
+                                changed |= self._pool.ensure_many(
+                                    entry.slot, next_len
+                                )
+                            else:
+                                changed |= self._pool.ensure(entry.slot, next_len)
                             # write-routing invariant: COW any still-shared
                             # page this step's append/migration would write
                             # through
@@ -2774,8 +2944,6 @@ class SlotServingEngine(ServingEngine):
                     # instant): nothing to decode; the requeued replays
                     # admit next step
                     return disposed
-            boundary = any(s.m >= self.model.max_latents for s in active)
-            executor = self._decode_executor(boundary)
             # armed by a serving_decode_step_ms p95 regression on a PRIOR
             # step: this step (dispatch + host-sync fence) runs under the
             # profiler capture; the step-number read (a registry lock) only
@@ -2783,15 +2951,33 @@ class SlotServingEngine(ServingEngine):
             with self._device_capture(
                 step=lambda: int(self.registry.counter("serving_decode_steps_total"))
             ):
-                if self._pool is not None:
-                    self._state, tokens = executor(
-                        self._exec_params, self._state, self._table_dev, key
+                if self._spec is not None:
+                    # speculative round: draft then verify, one fixed-shape
+                    # dispatch each; the verify's lanes handle latent growth
+                    # AND the m == max_latents boundary per row, so no
+                    # boundary-variant executor choice exists on this path
+                    cand = self._spec_draft_executor()(
+                        self._exec_params, self._state
                     )
+                    self._state, n_e = self._spec_verify_executor()(
+                        self._exec_params, self._state, cand
+                    )
+                    cand = np.asarray(cand)
+                    n_e = np.asarray(n_e)  # host sync: the scheduling point
                 else:
-                    self._state, tokens = executor(
-                        self._exec_params, self._state, key
+                    boundary = any(
+                        s.m >= self.model.max_latents for s in active
                     )
-                tokens = np.asarray(tokens)  # host sync: the scheduling point
+                    executor = self._decode_executor(boundary)
+                    if self._pool is not None:
+                        self._state, tokens = executor(
+                            self._exec_params, self._state, self._table_dev, key
+                        )
+                    else:
+                        self._state, tokens = executor(
+                            self._exec_params, self._state, key
+                        )
+                    tokens = np.asarray(tokens)  # host sync: the scheduling point
         except Exception as e:
             self.registry.observe(
                 "serving_decode_step_ms", (self._clock() - t0) * 1e3
@@ -2812,7 +2998,6 @@ class SlotServingEngine(ServingEngine):
                 self.registry.inc("kv_ragged_kernel_steps_total")
         self.registry.inc("serving_decode_rows_total", self.slots)
         self.registry.inc("serving_decode_rows_padded_total", self.slots - len(active))
-        self.registry.inc("serving_tokens_generated_total", len(active))
         eos = self.config.eos_token_id
         # Per-request token-latency accounting (docs/observability.md): the
         # np.asarray fence above materialized every slot's token, so all
@@ -2821,53 +3006,88 @@ class SlotServingEngine(ServingEngine):
         # wait and prefill included), inter-token latency for the rest
         # (previous token's instant → this one, so a long admission or a
         # boundary-variant step shows up in every RESIDENT row's ITL).
+        # A speculative round emits its whole accepted burst at this ONE
+        # instant: the burst's first token carries the round's latency,
+        # the rest sample 0.0 ms ITL — each emitted token still gets its
+        # own sample, so TTFT + Σ ITL telescopes exactly to the stream
+        # span, burst or not (pinned under FakeClock).
         token_at = self._clock()
         self._tl_mark("token_at_s", token_at)
         tier_tokens: Dict[str, int] = {}
         tenant_tokens: Dict[str, int] = {}
+        emitted_this_step = 0
         for entry in active:
-            token = int(tokens[entry.slot])
-            first = not entry.emitted
-            entry.emitted.append(token)
-            if entry.req.on_token is not None:
-                # incremental streaming: the fence above materialized this
-                # token, so the sink (the gateway's per-stream queue) gets
-                # it the same instant the scheduler does
-                self._emit_token(entry.req, len(entry.emitted) - 1, token)
-            entry.m = min(entry.m + 1, self.model.max_latents)
-            if first:
-                ttft_ms = (token_at - entry.req.ttft_from_s) * 1e3
-                self._observe_token_latency("serving_ttft_ms", ttft_ms)
-                if self.timeline is not None:
-                    self._tl_event(
-                        "tokens", request_id=entry.req.request_id,
-                        slot=entry.slot, first=True,
-                        ttft_ms=round(ttft_ms, 3),
-                    )
-                if self.tracer is not None:
-                    self.tracer.event(
-                        "serving.first_token", trace_id=entry.req.trace_id,
-                        slot=entry.slot, ttft_ms=round(ttft_ms, 3),
-                    )
+            if self._spec is None:
+                row_tokens = [int(tokens[entry.slot])]
             else:
-                itl_ms = (token_at - entry.last_token_at) * 1e3
-                self._observe_token_latency("serving_inter_token_ms", itl_ms)
-                if self.timeline is not None:
-                    self._tl_event(
-                        "tokens", request_id=entry.req.request_id,
-                        slot=entry.slot, first=False,
-                        itl_ms=round(itl_ms, 3),
-                    )
-            entry.last_token_at = token_at
-            # per-tier / per-tenant token attribution, batched to one
-            # registry/dict bump per label per step (hot-path discipline)
-            tkey = tier_label(entry.req.priority)
-            tier_tokens[tkey] = tier_tokens.get(tkey, 0) + 1
-            nkey = tenant_label(entry.req.tenant)
-            tenant_tokens[nkey] = tenant_tokens.get(nkey, 0) + 1
-            if (eos is not None and token == eos) or len(entry.emitted) >= entry.max_new:
-                self._retire(entry, "ok")
-                disposed += 1
+                # accepted burst, truncated host-side at EOS/max_new below
+                # exactly as n_e sequential steps would have stopped
+                row_tokens = [
+                    int(t) for t in cand[entry.slot, : int(n_e[entry.slot])]
+                ]
+            for token in row_tokens:
+                first = not entry.emitted
+                entry.emitted.append(token)
+                emitted_this_step += 1
+                if entry.req.on_token is not None:
+                    # incremental streaming: the fence above materialized
+                    # this token, so the sink (the gateway's per-stream
+                    # queue) gets it the same instant the scheduler does —
+                    # burst tokens flush one callback per index, in order
+                    self._emit_token(entry.req, len(entry.emitted) - 1, token)
+                entry.m = min(entry.m + 1, self.model.max_latents)
+                if first:
+                    ttft_ms = (token_at - entry.req.ttft_from_s) * 1e3
+                    self._observe_token_latency("serving_ttft_ms", ttft_ms)
+                    if self.timeline is not None:
+                        self._tl_event(
+                            "tokens", request_id=entry.req.request_id,
+                            slot=entry.slot, first=True,
+                            ttft_ms=round(ttft_ms, 3),
+                        )
+                    if self.tracer is not None:
+                        self.tracer.event(
+                            "serving.first_token", trace_id=entry.req.trace_id,
+                            slot=entry.slot, ttft_ms=round(ttft_ms, 3),
+                        )
+                else:
+                    itl_ms = (token_at - entry.last_token_at) * 1e3
+                    self._observe_token_latency("serving_inter_token_ms", itl_ms)
+                    if self.timeline is not None:
+                        self._tl_event(
+                            "tokens", request_id=entry.req.request_id,
+                            slot=entry.slot, first=False,
+                            itl_ms=round(itl_ms, 3),
+                        )
+                entry.last_token_at = token_at
+                # per-tier / per-tenant token attribution, batched to one
+                # registry/dict bump per label per step (hot-path discipline)
+                tkey = tier_label(entry.req.priority)
+                tier_tokens[tkey] = tier_tokens.get(tkey, 0) + 1
+                nkey = tenant_label(entry.req.tenant)
+                tenant_tokens[nkey] = tenant_tokens.get(nkey, 0) + 1
+                if (eos is not None and token == eos) or len(entry.emitted) >= entry.max_new:
+                    self._retire(entry, "ok")
+                    disposed += 1
+                    break
+        self.registry.inc("serving_tokens_generated_total", emitted_this_step)
+        if self._spec is not None:
+            # acceptance telemetry (docs/observability.md "spec_*"): the
+            # measured signal autotune_speculation gates on, and the live
+            # regression alarm a fleet watches after enabling speculation
+            accepted = int(sum(int(n_e[e.slot]) - 1 for e in active))
+            self.registry.inc("spec_rounds_total")
+            self.registry.inc(
+                "spec_tokens_proposed_total", self._spec.k * len(active)
+            )
+            self.registry.inc("spec_tokens_accepted_total", accepted)
+            self.registry.inc("spec_tokens_emitted_total", emitted_this_step)
+            if self.timeline is not None:
+                self._tl_event(
+                    "spec_round", rows=len(active),
+                    proposed=self._spec.k * len(active),
+                    accepted=accepted, emitted=emitted_this_step,
+                )
         for tkey, n in tier_tokens.items():
             self.registry.inc(f"serving_tokens_tier_{tkey}_total", n)
         for nkey, n in tenant_tokens.items():
@@ -2900,8 +3120,9 @@ class SlotServingEngine(ServingEngine):
     def warmup(self, config: Optional[GenerationConfig] = None) -> int:
         """Compile every executor the engine can ever dispatch — one prefill
         per feasible prompt bucket, the decode executor, its boundary
-        variant, and (when ``prefill_chunk`` is set) the one chunked-prefill
-        executor — then wipe the warmup garbage from the slot state.
+        variant, (when ``prefill_chunk`` is set) the one chunked-prefill
+        executor, and (when ``speculation`` is on) the draft + verify pair —
+        then wipe the warmup garbage from the slot state.
         Returns the number of fresh executor builds; after it, mixed-length
         traffic compiles nothing (pinned by tests).
 
@@ -3040,6 +3261,14 @@ class SlotServingEngine(ServingEngine):
                 self._state, _ = self._decode_executor(boundary)(
                     self._exec_params, self._state, key
                 )
+        if self._spec is not None:
+            # the speculative round's pair (+2 on the compile bound): the
+            # lane verify handles both phases per row, so no boundary
+            # variant exists on this path
+            cand0 = self._spec_draft_executor()(self._exec_params, self._state)
+            self._state, _ = self._spec_verify_executor()(
+                self._exec_params, self._state, cand0
+            )
         if self._prefix_index is not None:
             # the state blank below zeroes the device pool; cached blocks
             # must not survive it
@@ -3080,6 +3309,24 @@ class SlotServingEngine(ServingEngine):
             "decode_strategy_boundary": self._boundary_mode(),
             "kv_layout": self.kv_layout,
         })
+        out["speculation"] = {"mode": self.speculation}
+        if self._spec is not None:
+            rounds = int(counts.get("spec_rounds_total", 0))
+            proposed = int(counts.get("spec_tokens_proposed_total", 0))
+            accepted = int(counts.get("spec_tokens_accepted_total", 0))
+            emitted = int(counts.get("spec_tokens_emitted_total", 0))
+            out["speculation"].update({
+                "k": self._spec.k,
+                "draft_layers": self._spec.draft_layers,
+                "rounds": rounds,
+                "proposed": proposed,
+                "accepted": accepted,
+                "emitted": emitted,
+                # the autotuner's gate signal: drafted tokens the verify
+                # kept, over drafted tokens proposed
+                "acceptance_rate": round(accepted / max(1, proposed), 4),
+                "tokens_per_round": round(emitted / max(1, rounds), 4),
+            })
         if self.sharding is not None:
             out["mesh"] = {
                 "data": self.sharding.data_size,
@@ -3175,6 +3422,7 @@ class SlotServingEngine(ServingEngine):
         out["kv_layout"] = self.kv_layout
         out["prefix_cache"] = self.prefix_cache
         out["preemption"] = self.preemption
+        out["speculation"] = self.speculation
         out["mesh"] = (
             None if self.sharding is None else self.sharding.describe()
         )

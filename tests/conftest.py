@@ -140,6 +140,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "speculative: self-draft speculative-decoding test (truncated-stack "
+        "draft + single batched verify, greedy token-identity across slot "
+        "geometries incl. the 2x2 mesh, compile-bound +2, burst TTFT/ITL "
+        "telescoping, zero-leak under kv.exhaust, autotune pays/declines "
+        "pins; inference/speculative.py, serving/slots.py, docs/serving.md "
+        "\"Speculative decoding\"); CPU-fast, runs in the tier-1 suite with "
+        "a per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "timeout(seconds): per-test SIGALRM deadline — a hung scheduler loop "
         "fails THIS test instead of stalling the whole suite",
     )

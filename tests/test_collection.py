@@ -17,6 +17,8 @@ import pytest
 pytestmark = [pytest.mark.timeout(240)]
 
 
+@pytest.mark.slow  # 2026-08 audit: ~8s subprocess; tier-1 itself runs with
+# --continue-on-collection-errors, so a collection error already fails the run
 def test_collect_only_is_error_free():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -32,6 +32,8 @@ def _toggle(value: str):
     os.environ["PERCEIVER_FUSED_QKV"] = value
 
 
+@pytest.mark.slow  # 2026-08 audit: ~10s grad re-proof; mlm forward parity + flag
+# cache-key tests keep the tier-1 fused-path signal
 def test_clm_forward_and_grad_parity(fused_env):
     cfg = CausalLanguageModelConfig(
         vocab_size=32, max_seq_len=24, max_latents=8, num_channels=32,

@@ -459,6 +459,131 @@ def test_gateway_http_token_identity_fleet_and_bucket(tiny_model):
         gw2.close()
 
 
+# -- speculative burst flush ------------------------------------------------
+def _read_stream_indexed(resp):
+    """((index, token) pairs, terminal_record) — keeps the wire indices
+    the per-stream ``sent`` cursor orders (``_read_stream`` drops them)."""
+    pairs, term = [], None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith(b"data:"):
+            line = line[5:].strip()
+        rec = json.loads(line)
+        if rec.get("done"):
+            term = rec
+            break
+        pairs.append((int(rec["index"]), int(rec["token"])))
+    return pairs, term
+
+
+@pytest.mark.speculative
+@pytest.mark.timeout(300)
+def test_gateway_speculative_burst_flushes_frames_in_index_order(tiny_model):
+    """A speculative round that accepts a burst flushes one SSE frame PER
+    token, in index order — never a coalesced multi-token frame, never out
+    of order. On this 1-layer model a d=1 draft IS the full stack, so every
+    proposal verifies and every non-tail round lands k+1 tokens at once."""
+    model, params = tiny_model
+    cfg = _gcfg(max_new=6)
+    engine = SlotServingEngine(
+        model, params, cfg, TABLE, slots=2, kv_layout="paged",
+        speculation="k4d1", rng=jax.random.PRNGKey(1),
+    )
+    engine.warmup()
+    gw = StreamingGateway(engine).run_in_thread()
+    try:
+        for p in _prompts(11, [4, 7]):
+            conn, resp = _post_generate(
+                gw.host, gw.port, {"prompt_ids": p.tolist(), "stream": "jsonl"}
+            )
+            pairs, term = _read_stream_indexed(resp)
+            conn.close()
+            assert term is not None and term["status"] == "ok"
+            # exact-once per index: the burst arrived as len(pairs) separate
+            # frames numbered 0..n-1 in order
+            assert [i for i, _ in pairs] == list(range(cfg.max_new_tokens))
+            np.testing.assert_array_equal(
+                np.asarray([t for _, t in pairs], np.int32),
+                _ref(model, params, p, cfg),
+            )
+    finally:
+        gw.close()
+    spec = engine.stats()["speculation"]
+    assert spec["mode"] == "k4d1" and spec["acceptance_rate"] == 1.0
+    # far fewer verify rounds ran than frames hit the wire: the per-token
+    # frames above really were flushed from multi-token engine steps
+    assert spec["emitted"] == 2 * cfg.max_new_tokens
+    assert spec["rounds"] < spec["emitted"] and spec["tokens_per_round"] > 1.0
+    assert engine._pool.in_use == 0 and engine._pool.leaked() == 0
+
+
+@pytest.mark.speculative
+@pytest.mark.timeout(300)
+def test_gateway_speculative_failover_replay_no_duplicate_indices(tiny_model):
+    """Crash a replica mid-burst: the fleet re-runs the stream's request on
+    the survivor, whose replay re-emits indices from 0 — the gateway's
+    per-stream ``sent`` cursor drops the already-written prefix, so the wire
+    sees every index exactly once and tokens stay identical to generate()."""
+    model, params = tiny_model
+    cfg = _gcfg(max_new=12)
+    reg = MetricsRegistry()  # shared: outlives the crashed replica's restart
+
+    def factory():
+        return SlotServingEngine(
+            model, params, cfg, TABLE, slots=2, speculation="k4d1",
+            registry=reg, rng=jax.random.PRNGKey(1),
+        )
+
+    chaos = ChaosRegistry()
+    chaos.crash_replica(0, 3)  # 3rd supervised step: >=1 burst already out
+    fleet = FleetRouter([factory, factory], chaos=chaos)
+    fleet.warmup()
+    gw = StreamingGateway(fleet).run_in_thread()
+    prompts = _prompts(12, [5, 7])
+    results = [None, None]
+
+    def run_one(i):
+        conn, resp = _post_generate(
+            gw.host, gw.port,
+            {"prompt_ids": prompts[i].tolist(), "stream": "jsonl"},
+        )
+        try:
+            results[i] = _read_stream_indexed(resp)
+        finally:
+            conn.close()
+
+    try:
+        threads = [
+            threading.Thread(target=run_one, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+    finally:
+        gw.close()
+
+    assert chaos.fired_count("fleet.replica_step.0") == 1
+    assert fleet.stats()["failovers"] >= 1
+    for (pairs, term), p in zip(results, prompts):
+        assert term is not None and term["status"] == "ok"
+        # no duplicate indices across the replay: exactly 0..n-1, in order
+        assert [i for i, _ in pairs] == list(range(cfg.max_new_tokens))
+        np.testing.assert_array_equal(
+            np.asarray([t for _, t in pairs], np.int32),
+            _ref(model, params, p, cfg),
+        )
+    # the replay DID re-offer indices the wire already had: the engines
+    # emitted strictly more on_token calls than frames were written
+    emitted = reg.snapshot()["counters"]["spec_tokens_emitted_total"]
+    assert emitted > sum(len(pairs) for pairs, _ in results)
+
+
 @pytest.mark.timeout(300)
 def test_gateway_client_disconnect_cancels_and_frees(tiny_model):
     """A real client disconnect mid-generation: the gateway notices the

@@ -151,6 +151,73 @@ def test_lazy_ensure_boundary_crossing_and_exhaustion():
     assert pool.stats()["lazy_slots"] == 0
 
 
+def test_ensure_many_multi_page_burst_and_determinism():
+    """A speculative round's accepted burst maps every page it needs in
+    ONE call, and the block-id sequence is identical to n single ensure()
+    calls (same min-heap order) — the paged schedule stays deterministic
+    whether tokens arrive one per step or k+1 per round."""
+    pool = KVPagePool(num_blocks=12, block_size=4, slots=2, max_len=48)
+    pool.reserve(0, 20)  # 5 blocks
+    assert pool.ensure_many(0, 4) is True  # first page
+    assert pool.ensure_many(0, 4) is False  # already mapped: no-op
+    assert pool.ensure_many(0, 17) is True  # +9 tokens in one burst
+    assert pool.mapped_blocks(0) == 5
+    burst_row = list(pool.table_row(0))
+    pool.release(0)
+    pool.reserve(1, 20)
+    for tokens in (4, 8, 12, 16, 17):
+        pool.ensure(1, tokens)
+    assert list(pool.table_row(1)) == burst_row
+    pool.release(1)
+    assert pool.leaked() == 0
+
+
+def test_ensure_many_lazy_guard_mid_burst():
+    """A lazy slot's burst spends headroom only for pages past its hard
+    commitment: reservation-consuming pages never trip the guard, and a
+    burst needing more unreserved blocks than remain raises PoolExhausted
+    BEFORE mapping anything."""
+    pool = KVPagePool(num_blocks=6, block_size=4, slots=3, max_len=32)
+    pool.reserve_lazy(0, 4, 24)  # commit 1, soft watermark 6
+    assert pool.ensure_many(0, 4)  # consumes the commitment
+    pool.reserve(1, 12)  # 3 blocks hard -> headroom = 2
+    assert pool.headroom_blocks == 2
+    assert pool.ensure_many(0, 12)  # 2 lazy pages: exactly the headroom
+    before = list(pool.table_row(0))
+    with pytest.raises(PoolExhausted):
+        pool.ensure_many(0, 16)  # one more lazy page than remains
+    assert list(pool.table_row(0)) == before  # untouched on raise
+    assert pool.mapped_blocks(0) == 3
+    # past the soft watermark stays a loud structural bug, not pressure
+    with pytest.raises(ValueError):
+        pool.ensure_many(0, 25)
+    pool.release(0)
+    pool.release(1)
+    assert pool.leaked() == 0 and pool.allocs_total == pool.frees_total
+
+
+def test_ensure_many_exhaustion_leaves_table_untouched():
+    """The atomicity bar ensure() can't give a burst: exhaustion MID-SPAN
+    must not leave leading pages mapped. ensure_many pre-checks the whole
+    span, so the retry-after-preempt loop never double-counts pages."""
+    pool = KVPagePool(num_blocks=4, block_size=4, slots=2, max_len=32)
+    pool.reserve_lazy(0, 4, 20, headroom=0)  # commit 1 of worst-case 5
+    pool.ensure_many(0, 4)
+    pool.reserve(1, 8)  # 2 blocks hard -> headroom = 1
+    before = list(pool.table_row(0))
+    in_use = pool.in_use
+    with pytest.raises(PoolExhausted):
+        pool.ensure_many(0, 16)  # needs 3 lazy pages, 1 unreserved free
+    assert list(pool.table_row(0)) == before
+    assert pool.in_use == in_use  # nothing mapped, nothing leaked
+    # after the victim frees (release), the same burst succeeds
+    pool.release(1)
+    assert pool.ensure_many(0, 16)
+    assert pool.mapped_blocks(0) == 4
+    pool.release(0)
+    assert pool.leaked() == 0
+
+
 # -- ctor validation ---------------------------------------------------------
 def test_preemption_requires_paged_layout(tiny_model):
     model, params = tiny_model
@@ -191,6 +258,9 @@ def _longtail(rng, n=6):
     return prompts, cfgs
 
 
+@pytest.mark.slow  # 2026-08 audit: ~18s; plain-paged preemption identity +
+# zero-leak stay tier-1 via the kv.exhaust storm drill here and the
+# speculative storm drill (tests/test_speculative.py)
 def test_paged_preemption_token_identity_and_zero_leak(tiny_model):
     """Genuine exhaustion (no chaos): lazy admission packs more residents
     than the pool can grow, boundary crossings preempt victims, preempted

@@ -32,6 +32,7 @@ def _grads(checkpointing: bool, offloading: bool):
     return float(loss), grads
 
 
+@pytest.mark.slow  # 2026-08 audit: ~12s grad re-proof; remat equivalence stays tier-1
 def test_offload_grads_finite_and_match_plain_remat():
     loss_p, grads_p = _grads(checkpointing=True, offloading=False)
     try:

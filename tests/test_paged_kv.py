@@ -188,6 +188,8 @@ def test_paged_parity_mid_flight_admit_boundary_recycled(tiny_model):
     assert paged_engine._pool.in_use == 0 and paged_engine._pool.leaked() == 0
 
 
+@pytest.mark.slow  # 2026-08 audit: ~10s; chunked parity stays tier-1 via the
+# decode-strategy three-geometry drill (still in the `-m paged_kv` lane)
 def test_paged_parity_chunked_prefill_geometries(tiny_model):
     """Chunked admission under the paged layout — pages mapped per chunk
     call, the finalize scattering the staged row through the block table —

@@ -170,6 +170,7 @@ def test_mlm_sequence_parallel_matches_single_device(baselines):
     np.testing.assert_allclose(losses, baselines["mlm"], rtol=2e-4)
 
 
+@pytest.mark.slow  # 2026-08 audit: ~16s; tp-shard layout test keeps tier-1 MLM coverage
 def test_mlm_fsdp_shards_query_provider_and_tied_embedding():
     """The structures unique to this family must actually shard under FSDP
     (min_fsdp_size=0 forces even the tiny test leaves to split)."""

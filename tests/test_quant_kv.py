@@ -126,6 +126,8 @@ def test_gather_kv_null_block_semantics():
 
 
 # -- engine determinism -----------------------------------------------------
+@pytest.mark.slow  # 2026-08 audit: ~9s; int8 engine parity stays tier-1 via the
+# preemption [int8] geometry and the speculative paged_int8 geometry drills
 def test_int8_engine_internal_determinism(tiny_model):
     """Quantization happens ONCE at append, so every admission path must
     agree bit-for-bit: chunked prefill (staged rows quantized per chunk)
@@ -340,6 +342,8 @@ def test_quality_gate_autotune_and_persistence(tiny_model, tmp_path, monkeypatch
         strategy_mod.reset_registry()
 
 
+@pytest.mark.slow  # 2026-08 audit: ~11s; the gate logic itself is pinned by
+# the quality-gate autotune test, still in the `-m quant_kv` lane
 def test_engine_warmup_quant_fallback_counter(tiny_model, monkeypatch):
     """Serving warmup under ``kv_layout="auto"`` with an impossible
     quality budget: the autotuner's gate fails, the engine does NOT land

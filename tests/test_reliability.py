@@ -252,6 +252,8 @@ def test_skip_policy_discards_bad_step_and_finishes(tmp_path):
     assert any("non_finite_skipped" in l for l in lines)
 
 
+@pytest.mark.slow  # 2026-08 audit: ~13s; joins its halt/divergence siblings at
+# `slow` depth — the cadence and invalid-policy pins keep tier-1 coverage
 def test_rollback_policy_restores_snapshot_and_replays(tmp_path):
     """Acceptance drill: after K=2 consecutive injected-NaN steps the trainer
     restores the latest finite snapshot, rewinds the data stream, and the
@@ -282,6 +284,7 @@ def test_rollback_requires_snapshot_cadence(tmp_path):
         _tr_fit(tmp_path, 4, non_finite_policy="rollback")
 
 
+@pytest.mark.slow  # 2026-08 audit: ~10s; cadence/invalid-policy pins stay tier-1
 def test_rollback_rejects_stale_snapshots_from_previous_run(tmp_path):
     """A fresh rollback fit into a root whose resume/ dir holds a previous
     run's snapshots must fail with an actionable error at fit start — a

@@ -24,12 +24,14 @@ def _qkv(rng, b, h, i, j, d):
 
 
 CASES = [
-    # (i, j, causal, with_pad)
+    # (i, j, causal, with_pad). 2026-08 runtime audit: the ~10s right-
+    # aligned/causal re-proofs keep `slow` depth; the cheap square + padded
+    # cases stay tier-1 as the jax-API drift signal.
     (64, 64, False, False),
-    (64, 64, True, False),
-    (64, 192, True, False),   # right-aligned causal, offset 128
+    pytest.param(64, 64, True, False, marks=pytest.mark.slow),
+    pytest.param(64, 192, True, False, marks=pytest.mark.slow),
     (64, 192, False, True),
-    (64, 192, True, True),
+    pytest.param(64, 192, True, True, marks=pytest.mark.slow),
 ]
 
 
@@ -44,6 +46,7 @@ def test_matches_unsharded(rng, seq_mesh, i, j, causal, with_pad):
     np.testing.assert_allclose(actual, expected, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # 2026-08 audit: 33s grad re-proof; forward parity stays tier-1
 def test_grads_flow(rng, seq_mesh):
     q, k, v = _qkv(rng, 1, 2, 64, 192, 16)
 
@@ -73,6 +76,7 @@ def test_jit_under_mesh(rng, seq_mesh):
     )
 
 
+@pytest.mark.slow  # 2026-08 audit: 17s; op-level parity + jit dispatch stay tier-1
 def test_model_level_ring_dispatch(rng):
     """attention_impl='ring' reaches the model path (VERDICT r2 ask #9):
     a CLM forward under a seq-sharded mesh must match the xla impl."""

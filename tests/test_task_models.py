@@ -149,6 +149,7 @@ class TestCausalLanguageModel:
         v = model.init(KEY, ids, 2)
         assert "out_norm" in v["params"]
 
+    @pytest.mark.slow  # 2026-08 audit: ~8s grad re-proof; forward pins stay tier-1
     def test_tied_embeddings_gradient_flows(self, rng):
         """Loss gradients must reach the embedding through both the input
         and the tied output path."""
