@@ -1,7 +1,7 @@
 # Task runner (parity with the reference's invoke tasks, reference tasks.py:1-101).
 PY ?= python
 
-.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident timeline slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench speculative spec-bench dryrun lint
+.PHONY: test test-fast chaos fleet-chaos elasticity elasticity-bench obs obs-report incident timeline slo slo-bench gateway stream-bench decode-strategy decode-tune cov bench serve-bench paged-bench quant-kv quant-bench prefix-cache prefix-bench preemption preempt-bench swap swap-bench speculative spec-bench dryrun lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -239,6 +239,41 @@ preempt-bench:
 	model = CausalLanguageModel(cfg); \
 	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
 	print(json.dumps({'preemption': bench._bench_preemption(model, params, cfg)}, indent=2))"
+
+# host-swap suite (docs/serving.md "Host-swap preemption"): extract/
+# restore primitive units, token-identity through swap-out/restore across
+# paged/int8/prefix-shared/chunked geometries, kv.exhaust zero-leak storm
+# under preemption=swap, auto per-victim arbitration honesty, swap_gbps
+# calibration + registry persistence — CPU-fast, also tier-1
+swap:
+	$(PY) -m pytest tests/ -q -m swap --continue-on-collection-errors
+
+# recompute-vs-swap-vs-auto preemption A/B over a generated-length sweep
+# at ONE fixed pool budget (docs/serving.md "Host-swap preemption"):
+# wall-to-drain + goodput-under-SLO per arm per length, the measured
+# crossover length where paying transfer beats paying recompute, greedy
+# token-identity vs an unpressured baseline, and the model honesty bars
+# (predicted vs realized advantage sign, auto never picks the worse arm).
+# The CPU lane runs a REDUCED shape (512 ctx), not CPU_SHAPE: the pool
+# budget is denominated in full-context slots, so at 2048 ctx a sweep
+# with genuine exhaustion pressure needs 200+-token decodes per request
+# and the recompute arm's replay churn makes the lane hours-scale on
+# CPU. At 512 ctx the 1-slot budget is 32 x 16-token blocks, 8
+# residents cross it from the FIRST sweep point, and victim replays
+# stay cheap — every point preempts for real instead of measuring
+# compile noise. On real TPU run _bench_swap at the full shape with
+# default kwargs to measure the uncapped crossover (ROADMAP item 2)
+swap-bench:
+	$(PY) -c "import json, jax, jax.numpy as jnp; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	import importlib.util; \
+	spec = importlib.util.spec_from_file_location('bench', 'bench.py'); \
+	bench = importlib.util.module_from_spec(spec); spec.loader.exec_module(bench); \
+	from perceiver_io_tpu.models.text.clm import CausalLanguageModel; \
+	cfg = bench._mk_config((1, 512, 64, 128, 4, 2)); \
+	model = CausalLanguageModel(cfg); \
+	params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cfg.max_seq_len), jnp.int32), cfg.max_seq_len - cfg.max_latents)['params']; \
+	print(json.dumps({'swap': bench._bench_swap(model, params, cfg, budget_slots=1, n_requests=12, lengths=(24, 64, 128))}, indent=2))"
 
 # speculative-decoding suite (docs/serving.md "Speculative decoding"):
 # truncated-stack self-draft + single batched verify — greedy token-

@@ -585,6 +585,28 @@ def child_run(shape, out_path: str, force_cpu: bool = False, deadline_s: float =
                 res.update(extras={**res.data["extras"], "preemption": {
                     "error": f"{type(e).__name__}: {e}"}})
 
+        # ---- extra: host-swap A/B (recompute vs swap vs auto over length) ----
+        if left() > 150.0:
+            log("run: host-swap A/B (recompute vs swap vs auto preemption "
+                "over a generated-length sweep)")
+            try:
+                swp = _bench_swap(model, state.params, cfg)
+                res.update(extras={**res.data["extras"], "swap": swp})
+                last = swp["sweep"][-1] if swp["sweep"] else {}
+                log(f"run: host-swap crossover_length="
+                    f"{swp['crossover_length']} (longest point: recompute "
+                    f"{last.get('recompute', {}).get('wall_s')}s vs swap "
+                    f"{last.get('swap', {}).get('wall_s')}s, realized "
+                    f"advantage {last.get('realized_advantage_ms')}ms, "
+                    f"predicted {last.get('predicted_advantage_ms')}ms), "
+                    f"token_identical={swp['token_identical']}, "
+                    f"auto_agrees={swp['auto_agrees']}, sign_agrees="
+                    f"{swp['advantage_sign_agrees']}")
+            except Exception as e:
+                log(f"run: host-swap A/B failed ({type(e).__name__}: {e})")
+                res.update(extras={**res.data["extras"], "swap": {
+                    "error": f"{type(e).__name__}: {e}"}})
+
         # ---- extra: quantized-KV A/B (exact vs int8 pool at one budget) ----
         if left() > 150.0:
             log("run: quant-KV A/B (exact vs int8 paged pool at one budget)")
@@ -1513,6 +1535,172 @@ def _bench_preemption(model, params, cfg, *, budget_slots: int = 3,
                           "recompute"),
         "max_residents_ratio": round(lazy_res / max(1, strict_res), 2),
         "token_identical": token_identical,
+    }
+
+
+def _bench_swap(model, params, cfg, *, budget_slots: int = 3,
+                engine_slots: int = 8, n_requests: int = 12,
+                block_size: int = None, lengths=None):
+    """Recompute vs host-swap vs auto preemption over a generated-length
+    sweep at ONE fixed pool budget (ISSUE 20 acceptance; docs/serving.md
+    "Host-swap preemption"). Every request declares the same ``max_new``
+    per sweep point, so a victim's discarded work grows linearly with the
+    sweep axis while its page footprint (the swap transfer) stays bounded
+    by the pool — recompute cost scales with generated length, swap cost
+    doesn't, and the measured wall-clock crossing is the
+    ``crossover_length`` the post-mortem model predicts.
+
+    Recorded acceptance numbers per arm and length: wall-to-drain,
+    ``goodput_under_slo`` (SLO pinned at the recompute arm's p50
+    completion per length), preemption/swap churn, and greedy
+    ``token_identical`` vs an UNPRESSURED baseline. Plus the two model
+    honesty bars: ``predicted_advantage_ms`` (recompute arm's post-mortem
+    ``swap_advantage_ms``) must agree in sign with
+    ``realized_advantage_ms`` (recompute wall - swap wall) at the longest
+    length, and the ``auto`` arm's per-victim dispositions must never
+    pick the arm its own post-mortem record scores worse
+    (``auto_agrees``)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.inference import cast_float_params
+    from perceiver_io_tpu.inference.generate import GenerationConfig
+    from perceiver_io_tpu.inference.samplers import SamplingConfig
+    from perceiver_io_tpu.serving import BucketTable, SlotServingEngine
+
+    params = cast_float_params(params, jnp.bfloat16)
+    n = cfg.max_seq_len
+    num_latents = min(4, cfg.max_latents)
+    if block_size is None:
+        block_size = max(4, n // 32)
+    pages_per_slot = -(-n // block_size)
+    prompt_len = max(num_latents, min(64, n // 8))
+    max_len = min(n - prompt_len, model.max_prefix_len)
+    if lengths is None:
+        lengths = sorted({max(2, max_len // 8), max(3, max_len // 2),
+                          max(4, max_len)})
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, size=prompt_len,
+                            dtype=np.int32) for _ in range(n_requests)]
+    table = BucketTable(prompt_lens=(prompt_len,), batch_sizes=(1,))
+    budget_blocks = budget_slots * pages_per_slot
+    base = GenerationConfig(
+        max_new_tokens=2, num_latents=num_latents,
+        sampling=SamplingConfig(temperature=0.0),  # greedy: identity check
+    )
+
+    def run(preemption, gen_cfg, kv_blocks, *, warm=True):
+        def make_engine():
+            return SlotServingEngine(
+                model, params, gen_cfg, table, slots=engine_slots,
+                kv_layout="paged", kv_block_size=block_size,
+                kv_blocks=kv_blocks, preemption=preemption,
+                admit_headroom_blocks=1 if preemption else 0,
+            )
+        if warm:
+            compile_engine = make_engine()
+            for p in prompts:
+                compile_engine.submit(p, config=gen_cfg)
+            compile_engine.run_until_idle()
+        engine = make_engine()
+        handles = [engine.submit(p, config=gen_cfg) for p in prompts]
+        done_at = [None] * len(handles)
+        t0 = time.perf_counter()
+        while engine.pending():
+            engine.step()
+            now = time.perf_counter() - t0
+            for i, h in enumerate(handles):
+                if done_at[i] is None and h.done:
+                    done_at[i] = now
+        dt = time.perf_counter() - t0
+        return engine, dt, [h.result for h in handles], done_at
+
+    sweep = []
+    crossover = None
+    for length in lengths:
+        gen_cfg = dataclasses.replace(base, max_new_tokens=int(length))
+        # unpressured baseline: enough blocks that nothing preempts
+        _, _, ref_outs, _ = run(
+            None, gen_cfg, engine_slots * pages_per_slot, warm=True
+        )
+        arms = {}
+        for mode in ("recompute", "swap", "auto"):
+            # warmed per arm: the pool size is part of the executor shape,
+            # so the baseline's compile pass doesn't cover the budget pool
+            engine, dt, outs, done = run(
+                mode, gen_cfg, budget_blocks, warm=True
+            )
+            arms[mode] = (engine, dt, outs, done)
+        slo_s = float(np.median(
+            [t for t in arms["recompute"][3] if t is not None]
+        ))
+        point = {"length": int(length), "slo_s": round(slo_s, 4)}
+        for mode, (engine, dt, outs, done) in arms.items():
+            pre = engine.stats().get("preemption") or {}
+            pm = engine.postmortems()
+            point[mode] = {
+                "wall_s": round(dt, 4),
+                "goodput_under_slo": round(
+                    sum(1 for t in done if t is not None and t <= slo_s)
+                    / len(done), 4
+                ),
+                "preemptions": int(pre.get("preemptions", 0)),
+                "swaps": int(pre.get("swaps", 0)),
+                "swap_restores": int(pre.get("swap_restores", 0)),
+                "swap_bytes": int(pre.get("swap_bytes", 0)),
+                "token_identical": all(
+                    a is not None and b is not None
+                    and bool(np.array_equal(a, b))
+                    for a, b in zip(outs, ref_outs)
+                ),
+                "postmortems": {
+                    k: pm[k] for k in (
+                        "count", "swapped", "recompute_est_ms",
+                        "swap_est_ms", "swap_advantage_ms",
+                        "swap_measured_ms", "swap_link_gbps",
+                    )
+                },
+            }
+        point["realized_advantage_ms"] = round(
+            (arms["recompute"][1] - arms["swap"][1]) * 1e3, 3
+        )
+        point["predicted_advantage_ms"] = \
+            point["recompute"]["postmortems"]["swap_advantage_ms"]
+        # the auto honesty bar: every per-victim disposition matches the
+        # cheaper side of its own post-mortem record
+        auto_recent = arms["auto"][0].postmortems()["recent"]
+        point["auto_agrees"] = all(
+            r["mode"] == ("swap" if r["swap_est_ms"] < r["recompute_est_ms"]
+                          else "recompute")
+            for r in auto_recent
+        )
+        if crossover is None and point["realized_advantage_ms"] > 0:
+            crossover = int(length)
+        sweep.append(point)
+
+    last = sweep[-1] if sweep else {}
+    return {
+        "workload": {
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "lengths": [int(x) for x in lengths],
+            "block_size": block_size,
+            "hbm_budget_blocks": budget_blocks,
+        },
+        "sweep": sweep,
+        "crossover_length": crossover,
+        "token_identical": all(
+            p[mode]["token_identical"]
+            for p in sweep for mode in ("recompute", "swap", "auto")
+        ),
+        "auto_agrees": all(p["auto_agrees"] for p in sweep),
+        "advantage_sign_agrees": (
+            bool(last) and
+            (last["predicted_advantage_ms"] > 0)
+            == (last["realized_advantage_ms"] > 0)
+        ),
     }
 
 

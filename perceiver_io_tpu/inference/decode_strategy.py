@@ -159,6 +159,12 @@ _KV_REGISTRY: dict = {}
 _PREFIX_REGISTRY: dict = {}
 #: same key space -> {"speculation": "off"|"k{K}d{D}", ...} measurement entry
 _SPEC_REGISTRY: dict = {}
+#: platform -> {"swap_gbps": float, ...} calibrated host-link rate for the
+#: swap-preemption cost model (docs/serving.md "Host-swap preemption").
+#: Keyed by PLATFORM ALONE: the device<->host link is a hardware property,
+#: not a model-shape or trace-env one — one measured rate serves every
+#: engine on the box.
+_SWAP_REGISTRY: dict = {}
 _FILE_LOADED: set = set()  # paths already merged into the registries
 
 
@@ -351,12 +357,58 @@ def resolve_speculation(
     return mode
 
 
+def lookup_swap_gbps(platform: Optional[str] = None) -> Optional[float]:
+    """Calibrated host-link rate (decimal GB/s) for this platform, or
+    None when no swap has ever been measured here — the slot engine's
+    ``swap_link_gbps=None`` resolution falls back to its prior then."""
+    _maybe_load_env_file()
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    entry = _SWAP_REGISTRY.get(str(platform))
+    return None if entry is None else float(entry["swap_gbps"])
+
+
+def swap_entry(platform: Optional[str] = None) -> Optional[dict]:
+    """The full calibrated-swap registry entry (rate + measurement
+    metadata), or None. Read-only view for observability and the bench
+    probes."""
+    _maybe_load_env_file()
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    entry = _SWAP_REGISTRY.get(str(platform))
+    return None if entry is None else dict(entry)
+
+
+def record_swap_gbps(gbps: float, *, platform: Optional[str] = None,
+                     **extra) -> dict:
+    """Store a measured host-link rate for this platform (plus
+    measurement metadata — bytes moved, transfer wall time); returns the
+    entry. The slot engine calls this after every real swap transfer, so
+    the persisted artifact carries a calibrated rate forward to the next
+    process (``swap_entries``, beside ``spec_entries``)."""
+    gbps = float(gbps)
+    if not gbps > 0:
+        raise ValueError(f"swap_gbps must be > 0, got {gbps!r}")
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    entry = {"swap_gbps": gbps, **extra}
+    _SWAP_REGISTRY[str(platform)] = entry
+    return entry
+
+
 def reset_registry() -> None:
     """Test isolation: drop every memoized verdict and forget loaded files."""
     _REGISTRY.clear()
     _KV_REGISTRY.clear()
     _PREFIX_REGISTRY.clear()
     _SPEC_REGISTRY.clear()
+    _SWAP_REGISTRY.clear()
     _FILE_LOADED.clear()
 
 
@@ -397,16 +449,23 @@ def save_registry(path: str) -> None:
             _SPEC_REGISTRY.items(), key=lambda kv: repr(kv[0])
         )
     ]
+    # platform-keyed (not shape/env-keyed): the host link is hardware
+    swap_entries = [
+        {"platform": platform, **entry}
+        for platform, entry in sorted(_SWAP_REGISTRY.items())
+    ]
     tmp = path + ".tmp"
     dirpath = os.path.dirname(path)
     if dirpath:
         os.makedirs(dirpath, exist_ok=True)
     with open(tmp, "w") as fh:
-        # version stays 1: kv_entries / prefix_entries / spec_entries are
-        # additive and readers written before them simply ignore the keys
+        # version stays 1: kv_entries / prefix_entries / spec_entries /
+        # swap_entries are additive and readers written before them simply
+        # ignore the keys
         json.dump(
             {"version": 1, "entries": entries, "kv_entries": kv_entries,
-             "prefix_entries": prefix_entries, "spec_entries": spec_entries},
+             "prefix_entries": prefix_entries, "spec_entries": spec_entries,
+             "swap_entries": swap_entries},
             fh, indent=2,
         )
     os.replace(tmp, path)
@@ -446,6 +505,20 @@ def load_registry(path: str) -> int:
             except (KeyError, ValueError, SyntaxError, TypeError):
                 continue
             dest[key] = entry
+            loaded += 1
+    swap_items = data.get("swap_entries")
+    if isinstance(swap_items, list):
+        for item in swap_items:
+            if not isinstance(item, dict):
+                continue
+            platform = item.get("platform")
+            gbps = item.get("swap_gbps")
+            if not isinstance(platform, str) or \
+                    not isinstance(gbps, (int, float)) or not gbps > 0:
+                continue
+            _SWAP_REGISTRY[platform] = {
+                k: v for k, v in item.items() if k != "platform"
+            }
             loaded += 1
     return loaded
 

@@ -105,6 +105,10 @@ HELP_TEXT = {
     "kv_prefix_cached_blocks": "Pool blocks currently retained by the prefix index.",
     "kv_preemptions_total": "Residents preempted under pool pressure: pages returned, request requeued for recompute-from-prompt replay (docs/serving.md \"Preemption & priorities\").",
     "kv_readmissions_total": "Previously preempted requests readmitted to a slot (each eventually completing token-identically).",
+    "kv_swaps_total": "Preemption victims whose KV pages were gathered to host memory instead of discarded (docs/serving.md \"Host-swap preemption\").",
+    "kv_swap_restores_total": "Swapped victims restored into free pool blocks at readmission, resuming decode at their pre-preemption position (no prompt replay).",
+    "kv_swap_bytes_total": "Bytes moved over the host link by swap extracts + restores (KV pages, int8 scales, and the resumable decode row).",
+    "kv_swap_ms": "Fenced wall time of one swap transfer leg (device-to-host extract or host-to-device restore).",
     "kv_pool_headroom_blocks": "Free pool blocks beyond the sum of live reservations — the lazy-admission safety margin; 0 means the next boundary crossing may preempt.",
     "spec_rounds_total": "Speculative draft+verify rounds executed (one fixed-shape round per scheduler pass with speculation on; docs/serving.md \"Speculative decoding\").",
     "spec_tokens_proposed_total": "Draft tokens proposed by the truncated-stack self-draft head (k per active row per round).",

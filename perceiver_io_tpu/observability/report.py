@@ -495,6 +495,14 @@ def _kv_pool_section(snapshot: dict) -> Optional[dict]:
             "readmissions": c("kv_readmissions_total") or 0,
             "headroom_blocks": headroom,
         }
+        # host-swap rollup (docs/serving.md "Host-swap preemption"):
+        # victims that paid transfer instead of recompute. Omitted when
+        # the run never swapped — recompute artifacts stay unchanged.
+        swaps = c("kv_swaps_total")
+        if swaps:
+            preemption["swaps"] = swaps
+            preemption["swap_restores"] = c("kv_swap_restores_total") or 0
+            preemption["swap_bytes"] = c("kv_swap_bytes_total") or 0
     return {
         "blocks": int(blocks),
         "blocks_in_use": in_use,
@@ -968,6 +976,12 @@ def format_report(analysis: dict, *, top: int = 20) -> str:
                     if pre["headroom_blocks"] is not None else ""
                 )
             )
+            if pre.get("swaps"):
+                out.append(
+                    f"host swap: {pre['swaps']} swapped out, "
+                    f"{pre['swap_restores']} restored, "
+                    f"{pre['swap_bytes']:,} B over the link"
+                )
 
     mesh = analysis.get("sharding")
     if mesh:
@@ -1441,10 +1455,10 @@ def run(events_path: str, snapshot_path: Optional[str] = None, *,
 
 #: Gantt cell glyphs, highest display priority first — a pass where a slot
 #: was both decoded and preempted shows the preemption.
-_GANTT_PRIORITY = "Xrap#=."
+_GANTT_PRIORITY = "SXRrap#=."
 _GANTT_LEGEND = (
-    "X=preempted  r=retired  a=admitted  p=prefill chunk  "
-    "#=token  ==resident (no token)  .=idle"
+    "S=swapped out  X=preempted  R=restored  r=retired  a=admitted  "
+    "p=prefill chunk  #=token  ==resident (no token)  .=idle"
 )
 
 
@@ -1561,7 +1575,7 @@ def analyze_timeline(records: List[dict],
         if isinstance(qd, int):
             queue_depths.append(qd)
         for kind in ("admitted", "chunks", "tokens", "finished",
-                     "preempted", "readmitted"):
+                     "preempted", "readmitted", "swapped", "restored"):
             entries = rec.get(kind) or []
             if entries:
                 kinds[kind] = kinds.get(kind, 0) + len(entries)
@@ -1577,6 +1591,8 @@ def analyze_timeline(records: List[dict],
         "finished_by_status": dict(sorted(by_status.items())),
         "preempted": kinds.get("preempted", 0),
         "readmitted": kinds.get("readmitted", 0),
+        "swapped": kinds.get("swapped", 0),
+        "restored": kinds.get("restored", 0),
     }
     counters = snapshot.get("counters") or {}
     if counters:
@@ -1681,6 +1697,10 @@ def timeline_gantt(records: List[dict], *, width: int = 96) -> List[str]:
                 mark(rid2slot.get(int(rid)), col, "r")
         for e in rec.get("preempted") or []:
             mark(e.get("slot"), col, "X")
+        for e in rec.get("swapped") or []:
+            mark(e.get("slot"), col, "S")
+        for e in rec.get("restored") or []:
+            mark(e.get("slot"), col, "R")
         prev_slots = slots
     first_step = recs[0].get("step")
     last_step = recs[-1].get("step")
@@ -1745,6 +1765,7 @@ def chrome_trace(records: List[dict],
             "ts": us(t0), "dur": max(us(t1) - us(t0), 0.0), "args": args,
         })
         for kind, label in (("admitted", "admit"), ("preempted", "preempt"),
+                            ("swapped", "swap"), ("restored", "restore"),
                             ("readmitted", "readmit"), ("finished", "finish")):
             for e in rec.get(kind) or []:
                 slot = e.get("slot")
@@ -1867,6 +1888,10 @@ def format_timeline(analysis: dict, records: List[dict], *,
             + ")  " if acct["finished_by_status"] else " "
         )
         + f"preempted={acct['preempted']}  readmitted={acct['readmitted']}"
+        + (
+            f"  swapped={acct['swapped']}  restored={acct['restored']}"
+            if acct.get("swapped") or acct.get("restored") else ""
+        )
     )
     if acct.get("registry"):
         out.append(

@@ -308,13 +308,24 @@ class ServeArgs:
     #: (plus --serve.admit_headroom_blocks) fit rather than reserving the
     #: worst case up front, and on genuine pool exhaustion the engine
     #: preempts the lowest-priority victim (pages returned, request
-    #: requeued, greedy replay token-identical). ``off`` (default) keeps
-    #: strict worst-case reservations. Requires a paged --serve.kv_layout.
+    #: requeued, greedy replay token-identical). ``swap`` ships the
+    #: victim's mapped KV pages (plus int8 scales) to host memory instead
+    #: of discarding them, and restores them into whatever free blocks
+    #: exist at readmission — the victim pays transfer instead of
+    #: recompute, the win once generated >> prompt. ``auto`` decides
+    #: per victim from the live recompute-vs-swap post-mortem model.
+    #: ``off`` (default) keeps strict worst-case reservations. Requires a
+    #: paged --serve.kv_layout.
     preemption: Optional[str] = None
     #: decode headroom blocks granted beyond the prompt at lazy admission
     #: (--serve.preemption only): higher = fewer early preemptions, lower
     #: = more residents per HBM byte. Default 0.
     admit_headroom_blocks: int = 0
+    #: host-swap link prior in GB/s (--serve.preemption=swap|auto only):
+    #: seeds the per-victim swap-vs-recompute cost model before the first
+    #: measured transfer calibrates it. Unset = the per-platform calibrated
+    #: value persisted in --serve.decode_strategy_file, else 16.0.
+    swap_gbps: Optional[float] = None
     #: prompt-length bucket grid; default = powers of two up to the context
     prompt_buckets: Optional[typing.Tuple[int, ...]] = None
     #: micro-batch size grid (``bucket`` engine; ignored by ``slots``)
@@ -1197,6 +1208,20 @@ class CLI:
                     "--serve.preemption (strict reservations already "
                     "cover the worst case)"
                 )
+            if args.swap_gbps is not None:
+                if args.preemption not in ("swap", "auto"):
+                    # inapplicable-flag convention: the link prior only
+                    # feeds the swap-vs-recompute cost model
+                    raise SystemExit(
+                        "--serve.swap_gbps applies with "
+                        "--serve.preemption=swap|auto (no other mode "
+                        "ships KV pages over the host link)"
+                    )
+                if args.swap_gbps <= 0:
+                    raise SystemExit(
+                        f"--serve.swap_gbps must be > 0, got "
+                        f"{args.swap_gbps}"
+                    )
             autoscale = args.autoscale
             if autoscale.max is None and any(
                 k.startswith("serve.autoscale.") for k in values
@@ -1318,6 +1343,15 @@ class CLI:
                 # (the crashed group frees for its rebuild), scale-ups
                 mesh_alloc = MeshGroupAllocator(base_spec)
             if args.engine == "slots":
+                # swap modes let the engine resolve the link rate itself
+                # (explicit --serve.swap_gbps > per-platform calibrated
+                # registry entry > 16.0 prior); other modes keep the
+                # post-mortem denominator pinned to the obs-side flag
+                if args.preemption in ("swap", "auto"):
+                    link_gbps = args.swap_gbps
+                else:
+                    link_gbps = obs.timeline.swap_gbps
+
                 def make_engine():
                     eng = SlotServingEngine(
                         model, params, gen_cfg, table, slots=args.slots,
@@ -1331,7 +1365,7 @@ class CLI:
                             mesh_alloc.acquire() if mesh_alloc is not None
                             else None
                         ),
-                        swap_link_gbps=obs.timeline.swap_gbps,
+                        swap_link_gbps=link_gbps,
                         **engine_kwargs
                     )
                     # inside the factory, not after it: fleet replica
@@ -1368,12 +1402,13 @@ class CLI:
                         "block tables to share)"
                     )
                 if args.preemption is not None \
-                        or args.admit_headroom_blocks != 0:
+                        or args.admit_headroom_blocks != 0 \
+                        or args.swap_gbps is not None:
                     raise SystemExit(
-                        "--serve.preemption/--serve.admit_headroom_blocks "
-                        "apply to --serve.engine=slots with a paged KV "
-                        "layout (the bucket engine has no page pool to "
-                        "preempt from)"
+                        "--serve.preemption/--serve.admit_headroom_blocks/"
+                        "--serve.swap_gbps apply to --serve.engine=slots "
+                        "with a paged KV layout (the bucket engine has no "
+                        "page pool to preempt from)"
                     )
                 if args.speculation != "auto":
                     raise SystemExit(
@@ -1496,6 +1531,7 @@ class CLI:
                     decode_mode == "auto"
                     or (args.engine == "slots" and (
                         kv_mode == "auto" or args.speculation == "auto"
+                        or args.preemption in ("swap", "auto")
                     ))
                 ):
                     strategy_mod.save_registry(args.decode_strategy_file)
@@ -1522,6 +1558,12 @@ class CLI:
 
             return self._serve_prompts(engine, tok, prompts, args, kit)
         finally:
+            # swap transfers calibrate the per-platform link rate DURING
+            # serving — persist the measured value beside spec_entries so
+            # the next process prices swap-vs-recompute from evidence
+            if args.decode_strategy_file \
+                    and args.preemption in ("swap", "auto"):
+                strategy_mod.save_registry(args.decode_strategy_file)
             # fit's teardown parity: even an exception mid-drain leaves a
             # final snapshot and a closed events file
             detach_ledger()

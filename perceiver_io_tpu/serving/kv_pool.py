@@ -58,6 +58,14 @@ Design rules (all pinned by ``tests/test_paged_kv.py``):
   retirement taxonomy: ``"shared"`` (a cached prefix block dropped by the
   index — LRU eviction under pool pressure, or a flush) and ``"cow"`` (a
   shared mapping's final deref through a copy-on-write replacement).
+- **Host swap (docs/serving.md "Host-swap preemption").** A preemption
+  victim under ``preemption="swap"`` gathers its pages to host memory
+  (:class:`SwapBundle`) and releases them tagged
+  ``frees_by_cause["swapped"]`` (:meth:`KVPagePool.extract`); restore
+  (:meth:`KVPagePool.restore`) re-maps the bundle into whatever free
+  blocks exist at readmission through the same block-table indirection —
+  no retrace, and prefix-shared leading blocks travel by reference (one
+  bundle retain), never by copy.
 
 Observability (docs/observability.md): the owning engine publishes
 ``kv_pool_blocks`` / ``kv_pool_blocks_in_use`` / ``kv_pool_blocks_high_water``
@@ -70,8 +78,37 @@ index (docs/serving.md "Prefix sharing").
 """
 from __future__ import annotations
 
+import dataclasses
 import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class SwapBundle:
+    """Self-contained host-side image of one swapped-out victim
+    (docs/serving.md "Host-swap preemption").
+
+    ``payload`` holds host numpy copies of the victim's pool pages
+    (``pool_k``/``pool_v`` gathered through its padded block-table row,
+    plus int8 per-block scales under ``kv_layout="paged_int8"``) and a
+    ``row`` dict of its per-slot state leaves. ``shared`` lists the
+    leading prefix-shared block ids that were deref'd rather than copied —
+    the bundle holds ONE retain on each (:meth:`KVPagePool.extract`), so
+    their device content survives until restore re-references them or the
+    bundle is dropped. Restore re-maps into whatever free blocks exist at
+    readmission; nothing in the bundle names the original private ids.
+    """
+
+    request_id: int
+    payload: dict
+    shared: List[int]
+    n_private: int
+    #: resident token positions (prompt + generated) restore must re-map
+    tokens: int
+    emitted: List[int]
+    m: int
+    last_token_at: float
+    bytes_moved: int
 
 
 class PoolExhausted(RuntimeError):
@@ -476,6 +513,52 @@ class KVPagePool:
     def release_all(self) -> int:
         """Failover path: every slot's pages back to the free list."""
         return sum(self.release(s, cause="failover") for s in range(self.slots))
+
+    # -- host swap (docs/serving.md "Host-swap preemption") ------------------
+    def extract(self, slot: int, cause: str = "swapped") -> Tuple[List[int], List[int]]:
+        """Swap-out bookkeeping for ``slot``: split its mapped blocks into
+        the leading prefix-shared run (refcount > 1 — deref'd, never
+        copied; the bundle takes ONE retain on each so the device content
+        stays resident) and the private tail, then :meth:`release` the
+        slot so the private blocks return to the free heap tagged
+        ``frees_by_cause[cause]``. Returns ``(shared, private)`` block-id
+        lists in page order. The caller must gather the device pages
+        BEFORE calling this — once released, the private ids may be
+        re-allocated by the very next admission.
+
+        Shared blocks form a leading run by construction:
+        :meth:`map_shared` only ever maps leading pages, and any later
+        write through a shared page went through :meth:`cow` first."""
+        blocks = list(self._mapped[slot])
+        shared: List[int] = []
+        for block in blocks:
+            if self._refcount.get(block, 0) > 1:
+                shared.append(block)
+            else:
+                break
+        for block in shared:
+            self.retain(block)
+        private = blocks[len(shared):]
+        self.release(slot, cause=cause)
+        return shared, private
+
+    def restore(self, slot: int, shared: Sequence[int], total_tokens: int,
+                resident_tokens: int) -> List[int]:
+        """Re-admit a swapped-out victim into ``slot``: reserve its FULL
+        worst case (pessimistic readmission — the anti-thrash rule; the
+        ``shared`` prefix blocks are excluded), re-map the shared run by
+        reference, then map fresh private blocks covering
+        ``resident_tokens`` positions from whatever the free heap holds
+        now. Returns the fresh private block ids (page order) — the engine
+        scatters the bundle's page payload into exactly these. The caller
+        drops the bundle's retains on ``shared`` afterwards (the slot now
+        holds its own references). Raises :class:`PoolExhausted` with the
+        slot untouched when the worst case doesn't fit yet."""
+        self.reserve(slot, total_tokens, shared_blocks=len(shared))
+        if shared:
+            self.map_shared(slot, shared)
+        self.ensure(slot, resident_tokens)
+        return list(self._mapped[slot][len(shared):])
 
     # -- views --------------------------------------------------------------
     def table(self):

@@ -156,14 +156,19 @@ from perceiver_io_tpu.serving.kv_pool import (
     KVPagePool,
     PoolExhausted,
     PrefixBlockIndex,
+    SwapBundle,
 )
 from perceiver_io_tpu.serving.sharding import as_serving_sharding
 
-#: preemption policies (docs/serving.md "Preemption & priorities"):
-#: ``off`` keeps reserve-worst-case admission; ``recompute`` admits on
-#: prompt pages and replays preempted victims from their original prompt
-#: (token-identical under greedy — no KV state is saved or restored).
-PREEMPTION_MODES = ("off", "recompute")
+#: preemption policies (docs/serving.md "Preemption & priorities" and
+#: "Host-swap preemption"): ``off`` keeps reserve-worst-case admission;
+#: ``recompute`` admits on prompt pages and replays preempted victims
+#: from their original prompt (token-identical under greedy — no KV
+#: state is saved or restored); ``swap`` gathers a victim's pool pages to
+#: host memory and restores them at readmission, skipping prompt replay
+#: entirely (pay transfer instead of recompute); ``auto`` picks swap vs
+#: recompute per victim from the live post-mortem cost model.
+PREEMPTION_MODES = ("off", "recompute", "swap", "auto")
 
 _EXECUTOR_CACHE: dict = register_executor_cache({})
 
@@ -531,6 +536,99 @@ def _build_page_copy_executor(block_size: int, out_shardings=None):
             out["scale_v"] = state["scale_v"].at[idx_dst].set(
                 state["scale_v"][idx_src]
             )
+        return out
+
+    return _jit(run, _donate(0), out_shardings)
+
+
+def _build_swap_extract_executor(block_size: int):
+    """Gather one victim's pool pages + per-slot row state for host swap
+    (docs/serving.md "Host-swap preemption"). ``table_row`` is the slot's
+    FULL padded block-table row and ``slot`` a traced scalar, so one
+    compile covers every victim geometry: unmapped tail entries are 0 and
+    gather null-block trash the restore routes right back to the null
+    block. NOT donated — the resident state must survive the gather (the
+    victim's neighbours keep decoding from it)."""
+
+    def run(state, table_row, slot):
+        flat = (
+            table_row[:, None] * block_size + jnp.arange(block_size)[None, :]
+        ).reshape(-1)
+        out = {
+            "pool_k": state["pool_k"][flat],
+            "pool_v": state["pool_v"][flat],
+        }
+        if "scale_k" in state:
+            out["scale_k"] = state["scale_k"][flat]
+            out["scale_v"] = state["scale_v"][flat]
+        row = {}
+        for key in ("window", "pad", "length", "m", "steps", "logits"):
+            row[key] = jax.lax.dynamic_index_in_dim(
+                state[key], slot, axis=0, keepdims=False
+            )
+        row["stack_k"] = tuple(
+            jax.lax.dynamic_index_in_dim(l, slot, axis=0, keepdims=False)
+            for l in state["stack_k"]
+        )
+        row["stack_v"] = tuple(
+            jax.lax.dynamic_index_in_dim(l, slot, axis=0, keepdims=False)
+            for l in state["stack_v"]
+        )
+        out["row"] = row
+        return out
+
+    return jax.jit(run)
+
+
+def _build_swap_restore_executor(block_size: int, out_shardings=None):
+    """Scatter a :class:`~perceiver_io_tpu.serving.kv_pool.SwapBundle`'s
+    payload back into the pool through the restored slot's NEW block-table
+    row and re-insert its row state — the device half of swap-in. Pages
+    below ``lo_blocks`` (the re-referenced prefix-shared run — their
+    device content never left) and the unmapped tail route to the null
+    block: a shared page is never written through, and the trash block
+    absorbs the padding writes exactly as prefill scatter does. int8
+    payloads restore bit-exact (no requant: content and scales travel
+    together)."""
+
+    def run(state, payload, table_row, slot, lo_blocks):
+        pages = table_row.shape[0]
+        pos = jnp.arange(pages * block_size)
+        flat = (
+            table_row[:, None] * block_size + jnp.arange(block_size)[None, :]
+        ).reshape(-1)
+        idx = jnp.where(pos >= lo_blocks * block_size, flat, pos % block_size)
+        out = dict(state)
+        out["pool_k"] = state["pool_k"].at[idx].set(
+            payload["pool_k"].astype(state["pool_k"].dtype)
+        )
+        out["pool_v"] = state["pool_v"].at[idx].set(
+            payload["pool_v"].astype(state["pool_v"].dtype)
+        )
+        if "scale_k" in state:
+            out["scale_k"] = state["scale_k"].at[idx].set(
+                payload["scale_k"].astype(state["scale_k"].dtype)
+            )
+            out["scale_v"] = state["scale_v"].at[idx].set(
+                payload["scale_v"].astype(state["scale_v"].dtype)
+            )
+
+        def upd(dst, src):
+            return jax.lax.dynamic_update_slice(
+                dst,
+                jnp.reshape(src, (1,) + dst.shape[1:]).astype(dst.dtype),
+                (slot,) + (0,) * (dst.ndim - 1),
+            )
+
+        row = payload["row"]
+        for key in ("window", "pad", "length", "m", "steps", "logits"):
+            out[key] = upd(state[key], row[key])
+        out["stack_k"] = tuple(
+            upd(d, s) for d, s in zip(state["stack_k"], row["stack_k"])
+        )
+        out["stack_v"] = tuple(
+            upd(d, s) for d, s in zip(state["stack_v"], row["stack_v"])
+        )
         return out
 
     return _jit(run, _donate(0), out_shardings)
@@ -917,8 +1015,9 @@ class SlotServingEngine(ServingEngine):
         to wait. ``None`` defers to ``PERCEIVER_PREFIX_CACHE`` then the
         measured registry (off when unrecorded).
     :param preemption: optimistic KV admission + eviction under memory
-        pressure — ``"off" | "recompute"`` (docs/serving.md "Preemption &
-        priorities"; paged layouts only). ``"recompute"`` drops the
+        pressure — ``"off" | "recompute" | "swap" | "auto"``
+        (docs/serving.md "Preemption & priorities" and "Host-swap
+        preemption"; paged layouts only). ``"recompute"`` drops the
         up-front worst-case reservation: a request admits when its PROMPT
         pages fit (plus ``admit_headroom_blocks``), decode pages allocate
         lazily at each block-boundary crossing, and when a crossing finds
@@ -926,8 +1025,22 @@ class SlotServingEngine(ServingEngine):
         lowest-priority-first, then most-pages-held, then fewest-tokens-
         generated, never a higher tier — returning every page
         (``frees_by_cause["preempted"]``) and requeueing it for a
-        token-identical greedy replay from its original prompt. ``"off"``
-        (default) keeps the reserve-worst-case admission unchanged.
+        token-identical greedy replay from its original prompt.
+        ``"swap"`` keeps the same admission and victim policy but gathers
+        the victim's pool pages (+ int8 scales) to host memory first
+        (``frees_by_cause["swapped"]``); readmission restores them into
+        whatever free blocks exist and resumes decoding at the
+        pre-preemption position — no prompt replay, transfer instead of
+        recompute, still greedy token-identical. ``"auto"`` arbitrates
+        per victim: swap when the post-mortem cost model (measured decode
+        step × tokens to replay vs victim bytes ÷ the calibrated
+        ``swap_link_gbps``) scores transfer cheaper, recompute otherwise.
+        ``"off"`` (default) keeps reserve-worst-case admission unchanged.
+    :param swap_link_gbps: host-link bandwidth (decimal GB/s) for the
+        post-mortem swap cost model and the ``auto`` arbitration. Default
+        ``None`` reads the calibrated per-platform registry entry
+        (``swap_entries``; every real swap refines it from measured
+        transfer time) and falls back to a 16 GB/s prior.
     :param admit_headroom_blocks: extra decode blocks hard-committed per
         lazy admission (``preemption="recompute"`` only) — a small buffer
         that absorbs the first boundary crossings without triggering
@@ -959,7 +1072,7 @@ class SlotServingEngine(ServingEngine):
                  prefix_cache: Optional[str] = None,
                  preemption: Optional[str] = None,
                  admit_headroom_blocks: int = 0,
-                 swap_link_gbps: float = 16.0,
+                 swap_link_gbps: Optional[float] = None,
                  speculation: Optional[str] = None,
                  mesh=None, **kwargs):
         super().__init__(
@@ -1020,6 +1133,9 @@ class SlotServingEngine(ServingEngine):
             "kv_ragged_kernel_steps_total",
             "kv_preemptions_total",
             "kv_readmissions_total",
+            "kv_swaps_total",
+            "kv_swap_restores_total",
+            "kv_swap_bytes_total",
             "spec_rounds_total",
             "spec_tokens_proposed_total",
             "spec_tokens_accepted_total",
@@ -1127,14 +1243,22 @@ class SlotServingEngine(ServingEngine):
         self._spec = speculative_mod.parse_speculation(self.speculation)
         if self._spec is not None:
             speculative_mod.validate_spec(self._spec, model, self.config)
-        if swap_link_gbps <= 0:
+        if swap_link_gbps is not None and swap_link_gbps <= 0:
             raise ValueError(
                 f"swap_link_gbps must be > 0, got {swap_link_gbps}"
             )
         #: modeled host-link bandwidth (decimal GB/s) for the preemption
-        #: post-mortems' hypothetical swap cost — ROADMAP item 2's
-        #: recompute-vs-swap crossover is measured against this rate
-        self.swap_link_gbps = float(swap_link_gbps)
+        #: post-mortems' swap cost and the auto policy's per-victim
+        #: arbitration. Resolution: explicit arg > the calibrated
+        #: per-platform registry entry (``swap_entries`` in the strategy
+        #: artifact — every real swap feeds a measured rate back through
+        #: ``record_swap_gbps``) > a 16 GB/s prior. ROADMAP item 2's
+        #: recompute-vs-swap crossover is measured against this rate.
+        self.swap_link_gbps = float(
+            swap_link_gbps
+            if swap_link_gbps is not None
+            else decode_strategy_mod.lookup_swap_gbps() or 16.0
+        )
         #: preemption accounting: tier -> victims preempted at that tier
         #: (the kv_preemptions_total by-tier breakdown stats() reports)
         self._preempted_by_tier: Dict[int, int] = {}
@@ -1145,8 +1269,10 @@ class SlotServingEngine(ServingEngine):
         #: the running totals survive eviction.
         self._postmortems: Deque[dict] = deque(maxlen=256)
         self._postmortem_totals = {
-            "count": 0, "tokens_discarded": 0, "pages_released": 0,
-            "victim_bytes": 0, "recompute_est_ms": 0.0, "swap_est_ms": 0.0,
+            "count": 0, "swapped": 0, "tokens_discarded": 0,
+            "pages_released": 0, "victim_bytes": 0,
+            "recompute_est_ms": 0.0, "swap_est_ms": 0.0,
+            "swap_measured_ms": 0.0,
         }
         #: per-tenant attribution (sanitized labels — observability.
         #: tenant_label): tokens generated and victims preempted; resident
@@ -1157,6 +1283,10 @@ class SlotServingEngine(ServingEngine):
         self._preempts_this_step = 0
         self._kv_counter_base = {"allocs": 0, "frees": 0}
         self._kv_waiting_id: Optional[int] = None  # last head counted waiting
+        #: request_id -> host-side SwapBundle for swap-preempted victims
+        #: awaiting readmission (docs/serving.md "Host-swap preemption");
+        #: must exist before _init_kv_state (the rebuild path drops them)
+        self._swap_bundles: Dict[int, SwapBundle] = {}
         self._init_kv_state(resolved)
         self._update_slot_gauges()
 
@@ -1178,6 +1308,15 @@ class SlotServingEngine(ServingEngine):
         autotuner) — callers must guarantee no residents."""
         from perceiver_io_tpu.models.core.modules import trace_env_fingerprint
 
+        # swapped-out bundles reference the OUTGOING pool's shared blocks
+        # and its device content — a rebuild invalidates both, so drop them
+        # while the old pool can still absorb the derefs (the queued
+        # requests replay from their prompts: still token-identical)
+        if getattr(self, "_swap_bundles", None) and \
+                getattr(self, "_pool", None) is not None:
+            for bundle in self._swap_bundles.values():
+                self._release_bundle(bundle, cause="swapped")
+            self._swap_bundles.clear()
         model, params = self.model, self.params
         self.kv_layout = layout
         if self.sharding is not None and self.sharding.model_size > 1:
@@ -1534,6 +1673,24 @@ class SlotServingEngine(ServingEngine):
             ledger_components=lambda: self._ledger_components(),
         )
 
+    def _swap_extract_executor(self):
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("kv_swap_extract"),
+            lambda: _build_swap_extract_executor(self.kv_block_size),
+            ledger_site="kv_swap_extract",
+            ledger_components=lambda: self._ledger_components(),
+        )
+
+    def _swap_restore_executor(self):
+        return cached_executor(
+            _EXECUTOR_CACHE, self._cache_key("kv_swap_restore"),
+            lambda: _build_swap_restore_executor(
+                self.kv_block_size, out_shardings=self._state_out_shardings()
+            ),
+            ledger_site="kv_swap_restore",
+            ledger_components=lambda: self._ledger_components(),
+        )
+
     def _boundary_mode(self) -> str:
         """Resolved boundary-phase strategy for the mixed decode variant
         (``decode_strategy`` ctor arg > env var > measured registry >
@@ -1878,8 +2035,8 @@ class SlotServingEngine(ServingEngine):
                 shared_blocks=shared_blocks,
             )
 
-    def _admit_need(self, req: ServeRequest,
-                    plan: Optional[_PrefixPlan]) -> int:
+    def _admit_need(self, req: ServeRequest, plan: Optional[_PrefixPlan],
+                    bundle: Optional[SwapBundle] = None) -> int:
         """Blocks the admission gate must see reservable before ``req``
         admits: its worst case (minus referenced prefix blocks) under
         up-front reservation, or just its private prompt pages + headroom
@@ -1895,8 +2052,16 @@ class SlotServingEngine(ServingEngine):
         optimistic class to the guaranteed class, a guaranteed resident's
         ``ensure`` draws only on its own reservation (it can never trip
         exhaustion), and each preemption's beneficiary keeps its tokens —
-        so memory preemptions are bounded by the request count."""
-        shared = len(plan.nodes) if plan is not None else 0
+        so memory preemptions are bounded by the request count.
+
+        A swap-preempted head (``bundle``) re-admits through
+        :meth:`_restore_admit`: full worst case (it was preempted, so the
+        pessimistic rule applies) minus the bundle's still-referenced
+        prefix-shared blocks, which re-map by reference."""
+        if bundle is not None:
+            shared = len(bundle.shared)
+        else:
+            shared = len(plan.nodes) if plan is not None else 0
         tokens = int(req.prompt.size) + req.config.max_new_tokens
         total = self._pool.blocks_needed(tokens) - shared
         if self.preemption == "off" or req.preemptions:
@@ -1963,16 +2128,28 @@ class SlotServingEngine(ServingEngine):
 
     def _preempt_victim(self, victim: Union[_Slot, _ChunkedAdmit], *,
                         beneficiary: Optional[int] = None) -> None:
-        """Preempt one victim (default ``recompute-from-prompt`` policy):
-        retire its slot with EVERY page returned
-        (``frees_by_cause["preempted"]`` — a prefix-sharing victim only
-        derefs published blocks, never frees them out from under other
-        sharers), discard its emitted tokens, and requeue the request as a
+        """Preempt one victim: retire its slot with EVERY page returned
+        (a prefix-sharing victim only derefs published blocks, never frees
+        them out from under other sharers) and requeue the request as a
         VOLUNTARY replay — status stays ``queued``, no failover-budget
-        analog is charged, and greedy re-decoding from the original prompt
-        is token-identical (the bar ``tests/test_kv_preemption.py`` pins).
-        Stream consumers see ``on_token`` indices restart at 0 on replay
-        and dedupe, exactly like a fleet failover."""
+        analog is charged.
+
+        The page disposition is policy-routed per victim. ``recompute``
+        discards the pages (``frees_by_cause["preempted"]``) and the
+        emitted tokens; greedy re-decoding from the original prompt is
+        token-identical (the bar ``tests/test_kv_preemption.py`` pins),
+        and stream consumers see ``on_token`` indices restart at 0 on
+        replay and dedupe, exactly like a fleet failover. ``swap``
+        gathers the pages to a host :class:`SwapBundle` first
+        (``frees_by_cause["swapped"]``); readmission restores them and
+        decoding RESUMES at the pre-preemption position — same greedy
+        tokens, paid in transfer instead of recompute
+        (``tests/test_kv_swap.py``). ``auto`` picks per victim from the
+        post-mortem cost model — both arms are priced from the SAME
+        numbers the post-mortem records, so the policy can never choose
+        the arm its own record scores worse. A mid-admission
+        (:class:`_ChunkedAdmit`) victim has no finished row to save and
+        always recomputes."""
         req = victim.req
         if isinstance(victim, _ChunkedAdmit):
             generated = 0
@@ -1981,7 +2158,30 @@ class SlotServingEngine(ServingEngine):
             generated = len(victim.emitted)
             self._slots[victim.slot] = None
         pages = self._pool.mapped_blocks(victim.slot)
-        self._kv_release(victim.slot, cause="preempted")
+        # post-mortem cost model (docs/observability.md "Scheduler
+        # timeline & post-mortems"), priced BEFORE the disposition so the
+        # auto arbitration and the record read identical numbers: the
+        # recompute cost the victim would pay (discarded tokens x the
+        # measured decode-step ms) against the host-swap cost (victim
+        # bytes / the calibrated link rate, one direction) — ROADMAP
+        # item 2's crossover curve, measured instead of assumed.
+        step_ms = self.registry.percentile("serving_decode_step_ms", 50.0) or 0.0
+        victim_bytes = pages * self.kv_block_size * (
+            self._kv_token_bytes + self._kv_scale_token_bytes
+        )
+        recompute_ms = generated * step_ms
+        swap_ms = victim_bytes / (self.swap_link_gbps * 1e9) * 1e3
+        mode = "recompute"
+        if not isinstance(victim, _ChunkedAdmit) and (
+            self.preemption == "swap"
+            or (self.preemption == "auto" and swap_ms < recompute_ms)
+        ):
+            mode = "swap"
+        if mode == "swap":
+            swap_out = self._swap_out(victim)
+        else:
+            swap_out = None
+            self._kv_release(victim.slot, cause="preempted")
         req.preemptions += 1
         req.started_at = None
         self._queue.append(req)  # the priority sort re-orders next pass
@@ -1995,23 +2195,14 @@ class SlotServingEngine(ServingEngine):
         tkey = tenant_label(req.tenant)
         self._preempted_by_tenant[tkey] = \
             self._preempted_by_tenant.get(tkey, 0) + 1
-        # post-mortem (docs/observability.md "Scheduler timeline &
-        # post-mortems"): the recompute cost this victim will actually pay
-        # (discarded tokens x the measured decode-step ms) against the
-        # host-swap cost a PCIe round trip WOULD have cost (victim bytes /
-        # the modeled link rate, one direction) — ROADMAP item 2's
-        # crossover curve, measured instead of assumed.
-        step_ms = self.registry.percentile("serving_decode_step_ms", 50.0) or 0.0
-        victim_bytes = pages * self.kv_block_size * (
-            self._kv_token_bytes + self._kv_scale_token_bytes
-        )
-        recompute_ms = generated * step_ms
-        swap_ms = victim_bytes / (self.swap_link_gbps * 1e9) * 1e3
         pm = {
             "request_id": req.request_id,
             "tenant": req.tenant,
             "priority": tier,
             "slot": victim.slot,
+            "mode": mode,
+            # under swap nothing is actually discarded — the field keeps
+            # the cost-model input (tokens replay WOULD have re-decoded)
             "tokens_discarded": generated,
             "pages_released": pages,
             "victim_bytes": int(victim_bytes),
@@ -2021,25 +2212,32 @@ class SlotServingEngine(ServingEngine):
             # positive = swapping out would have been cheaper than replay
             "swap_advantage_ms": round(recompute_ms - swap_ms, 3),
         }
+        if swap_out is not None:
+            pm["swap_measured_ms"] = round(swap_out["ms"], 3)
         self._postmortems.append(pm)
         totals = self._postmortem_totals
         totals["count"] += 1
+        totals["swapped"] += 1 if mode == "swap" else 0
         totals["tokens_discarded"] += generated
         totals["pages_released"] += pages
         totals["victim_bytes"] += int(victim_bytes)
         totals["recompute_est_ms"] += recompute_ms
         totals["swap_est_ms"] += swap_ms
+        if swap_out is not None:
+            totals["swap_measured_ms"] += swap_out["ms"]
         self._tl_event(
             "preempted", request_id=req.request_id, slot=victim.slot,
-            tenant=req.tenant, priority=tier, tokens_discarded=generated,
-            pages_released=pages, beneficiary=beneficiary,
+            tenant=req.tenant, priority=tier, mode=mode,
+            tokens_discarded=generated, pages_released=pages,
+            beneficiary=beneficiary,
         )
         self._update_slot_gauges()
         if self.tracer is not None:
             self.tracer.event(
                 "serving.preempted", trace_id=req.trace_id, slot=victim.slot,
-                priority=tier, tenant=req.tenant, pages_released=pages,
-                tokens_discarded=generated, beneficiary=beneficiary,
+                priority=tier, tenant=req.tenant, mode=mode,
+                pages_released=pages, tokens_discarded=generated,
+                beneficiary=beneficiary,
             )
         if self._preempts_this_step == 2 and self.flight_recorder is not None:
             # two victims in ONE scheduling instant = a preemption storm:
@@ -2057,6 +2255,172 @@ class SlotServingEngine(ServingEngine):
                 blocks_in_use=pool["in_use"],
             )
 
+    def _swap_out(self, victim: _Slot) -> dict:
+        """Device half of swap preemption (docs/serving.md "Host-swap
+        preemption"): gather the victim's pool pages + row state to host
+        numpy, release its blocks (``frees_by_cause["swapped"]``; leading
+        prefix-shared blocks are deref'd with ONE bundle retain each, so
+        their content stays device-resident), and park the
+        :class:`SwapBundle` keyed by request id for readmission. The
+        gather runs BEFORE the release — once freed, the private ids may
+        be re-allocated by the very next admission. Returns
+        ``{"bytes", "ms"}`` (the measured transfer, fed to
+        :meth:`_calibrate_swap`)."""
+        req = victim.req
+        slot = victim.slot
+        pool = self._pool
+        # copy: release() zeroes the live table row under us
+        row = np.array(pool.table_row(slot))
+        t0 = self._clock()
+        out = self._swap_extract_executor()(
+            self._state, jnp.asarray(row), np.int32(slot)
+        )
+        # tree-wide np.asarray both fences the gather and lands it in host
+        # memory — the device->host leg of the transfer being measured
+        host = jax.tree_util.tree_map(np.asarray, out)
+        wall = self._clock() - t0
+        shared, private = pool.extract(slot, cause="swapped")
+        self._push_table()
+        self._update_kv_gauges()
+        bytes_moved = int(sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(host)
+        ))
+        self._swap_bundles[req.request_id] = SwapBundle(
+            request_id=req.request_id,
+            payload=host,
+            shared=shared,
+            n_private=len(private),
+            tokens=int(req.prompt.size) + len(victim.emitted),
+            emitted=list(victim.emitted),
+            m=int(victim.m),
+            last_token_at=victim.last_token_at,
+            bytes_moved=bytes_moved,
+        )
+        ms = wall * 1e3
+        self.registry.inc("kv_swaps_total")
+        self.registry.inc("kv_swap_bytes_total", bytes_moved)
+        self.registry.observe("kv_swap_ms", ms)
+        self._calibrate_swap(bytes_moved, wall)
+        self._tl_event(
+            "swapped", request_id=req.request_id, slot=slot,
+            tenant=req.tenant, pages=len(shared) + len(private),
+            shared_blocks=len(shared), bytes=bytes_moved, ms=_round_ms(ms),
+        )
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.swapped", trace_id=req.trace_id, slot=slot,
+                pages=len(shared) + len(private), bytes=bytes_moved,
+                ms=_round_ms(ms),
+            )
+        return {"bytes": bytes_moved, "ms": ms}
+
+    def _restore_admit(self, req: ServeRequest, slot: int,
+                       bundle: SwapBundle) -> None:
+        """Readmit a swapped-out victim WITHOUT prompt replay: re-map its
+        bundle into whatever free blocks exist now (pessimistic full
+        worst-case reservation — the anti-thrash rule), scatter the host
+        payload back through the new block-table row, and resume the
+        resident at its pre-preemption position — emitted tokens, latent
+        count, and inter-token anchor all restored, so the next decode
+        step samples from the exact logits the victim was preempted with
+        (greedy token-identity by construction) and its ITL telescopes
+        across the swap gap. No new ``admitted`` event and no new
+        first-token mark: the request's timeline keeps its original
+        admission arc, joined by the ``swapped``/``restored`` legs."""
+        pool = self._pool
+        t0 = self._clock()
+        req.started_at = t0
+        self.registry.observe(
+            "serving_queue_wait_ms", (t0 - req.submitted_at) * 1e3
+        )
+        self._note_readmitted(req, slot)
+        total = int(req.prompt.size) + req.config.max_new_tokens
+        try:
+            pool.restore(slot, bundle.shared, total, bundle.tokens)
+        except BaseException:
+            # reserve raises with the pool untouched (restore's ensure is
+            # reservation-backed, infallible) — the caller fails the
+            # request, so the bundle's parking retains must drop here or
+            # the shared blocks strand allocated forever
+            self._release_bundle(bundle, cause="failover")
+            raise
+        pool.set_owner(slot, tenant_label(req.tenant))
+        # the slot now holds its own references on the shared run — drop
+        # the bundle's parking retains (live derefs, nothing freed)
+        for block in bundle.shared:
+            pool.deref(block, cause="swapped")
+        self._push_table()
+        self._update_kv_gauges()
+        t1 = self._clock()
+        payload = jax.tree_util.tree_map(jnp.asarray, bundle.payload)
+        self._state = self._swap_restore_executor()(
+            self._state, payload, jnp.asarray(pool.table_row(slot)),
+            np.int32(slot), np.int32(len(bundle.shared)),
+        )
+        # fence: the host->device leg must finish inside the measurement
+        np.asarray(self._state["length"])
+        wall = self._clock() - t1
+        ms = wall * 1e3
+        self.registry.inc("kv_swap_restores_total")
+        self.registry.inc("kv_swap_bytes_total", bundle.bytes_moved)
+        self.registry.observe("kv_swap_ms", ms)
+        self._calibrate_swap(bundle.bytes_moved, wall)
+        self._slots[slot] = _Slot(
+            req=req, slot=slot, max_new=req.config.max_new_tokens,
+            m=int(bundle.m), emitted=list(bundle.emitted),
+            last_token_at=bundle.last_token_at,
+        )
+        self._tl_event(
+            "restored", request_id=req.request_id, slot=slot,
+            tenant=req.tenant, pages=pool.mapped_blocks(slot),
+            shared_blocks=len(bundle.shared), tokens_resident=bundle.tokens,
+            bytes=bundle.bytes_moved, ms=_round_ms(ms),
+        )
+        if self.tracer is not None:
+            self.tracer.event(
+                "serving.restored", trace_id=req.trace_id, slot=slot,
+                pages=pool.mapped_blocks(slot), tokens=bundle.tokens,
+                bytes=bundle.bytes_moved, ms=_round_ms(ms),
+            )
+
+    def _calibrate_swap(self, bytes_moved: int, seconds: float) -> None:
+        """Fold one measured transfer into the live link-rate model and
+        the per-platform autotune registry (``swap_entries``, persisted
+        beside ``spec_entries``): an exponential half-life keeps the rate
+        current without letting one outlier transfer swing the auto
+        policy. Zero-duration measurements (FakeClock drills) are skipped
+        — deterministic tests keep the configured rate."""
+        if seconds <= 0 or bytes_moved <= 0:
+            return
+        measured = bytes_moved / (seconds * 1e9)
+        self.swap_link_gbps = round(
+            0.5 * self.swap_link_gbps + 0.5 * measured, 6
+        )
+        decode_strategy_mod.record_swap_gbps(
+            self.swap_link_gbps, bytes_moved=int(bytes_moved),
+            last_transfer_ms=round(seconds * 1e3, 3),
+        )
+
+    def _release_bundle(self, bundle: SwapBundle, cause: str) -> None:
+        """Drop one parked bundle's shared-block retains (its host payload
+        goes with it). ``cause`` tags any resulting physical frees — the
+        bundle may be the LAST reference to a prefix block whose index
+        entry was evicted while the victim waited."""
+        if self._pool is None:
+            return
+        for block in bundle.shared:
+            self._pool.deref(block, cause=cause)
+        if bundle.shared:
+            self._update_kv_gauges()
+
+    def _drop_bundle(self, request_id: int, cause: str) -> None:
+        """Invalidate a parked swap bundle when its request leaves the
+        queue by any path other than restore (cancel / evacuate /
+        failover / chaos) — the zero-leak bar counts bundle retains."""
+        bundle = self._swap_bundles.pop(request_id, None)
+        if bundle is not None:
+            self._release_bundle(bundle, cause=cause)
+
     def postmortems(self) -> dict:
         """The preemption post-mortem rollup (docs/observability.md
         "Scheduler timeline & post-mortems"): lifetime recompute-vs-swap
@@ -2067,15 +2431,18 @@ class SlotServingEngine(ServingEngine):
         totals = self._postmortem_totals
         return {
             "count": totals["count"],
+            "swapped": totals["swapped"],
             "tokens_discarded": totals["tokens_discarded"],
             "pages_released": totals["pages_released"],
             "victim_bytes": totals["victim_bytes"],
             "recompute_est_ms": round(totals["recompute_est_ms"], 3),
             "swap_est_ms": round(totals["swap_est_ms"], 3),
+            "swap_measured_ms": round(totals["swap_measured_ms"], 3),
             "swap_advantage_ms": round(
                 totals["recompute_est_ms"] - totals["swap_est_ms"], 3
             ),
             "swap_link_gbps": self.swap_link_gbps,
+            "swapped_waiting": len(self._swap_bundles),
             "recent": list(self._postmortems)[-8:],
         }
 
@@ -2480,6 +2847,12 @@ class SlotServingEngine(ServingEngine):
             self._retire(entry, "failed", error=error)
             failed += 1
         if self._pool is not None:
+            # parked swap bundles reference pool blocks about to be blanked
+            # — their queued requests fall back to replay-from-prompt
+            # (still token-identical), and the retains must drop while the
+            # pool's refcounts are still live
+            for rid in list(self._swap_bundles):
+                self._drop_bundle(rid, cause="failover")
             self._pool.release_all()
             if self._prefix_index is not None:
                 # the device pool is about to be blanked: cached prefix
@@ -2542,7 +2915,13 @@ class SlotServingEngine(ServingEngine):
                 self._retire(entry, "cancelled")
                 self._update_slot_gauges()
                 return True
-        return super().cancel(request_id)
+        if super().cancel(request_id):
+            # a queued swap victim leaves with its parked bundle: the
+            # shared-block retains return tagged like every other
+            # cancellation reclaim
+            self._drop_bundle(request_id, cause="cancelled")
+            return True
+        return False
 
     def evacuate(self, cause: str = "scale_down") -> int:
         """Withdraw every live request at once — the fleet scale-down path
@@ -2580,6 +2959,11 @@ class SlotServingEngine(ServingEngine):
                 kv_cause=cause,
             )
             evacuated += 1
+        # queued swap victims leave through the base path below — their
+        # parked bundles' retains return tagged with the evacuation cause
+        # (the scale-down drill's zero-leak bar counts them)
+        for rid in list(self._swap_bundles):
+            self._drop_bundle(rid, cause=cause)
         self._update_slot_gauges()
         return evacuated + super().evacuate(cause)
 
@@ -2682,6 +3066,13 @@ class SlotServingEngine(ServingEngine):
 
     def _step_pass(self) -> int:
         disposed = self._expire_overdue()
+        if self._swap_bundles:
+            # a parked bundle whose request left the queue by a path that
+            # bypasses the drop hooks (deadline expiry while queued) must
+            # not strand its shared-block retains
+            queued = {r.request_id for r in self._queue}
+            for rid in [r for r in self._swap_bundles if r not in queued]:
+                self._drop_bundle(rid, cause="retire")
         now = self._clock()
         for entry in self._active():
             req = entry.req
@@ -2741,8 +3132,12 @@ class SlotServingEngine(ServingEngine):
             if slot is None:
                 break
             head = self._queue[0]
+            # a swap-preempted head restores from its parked bundle: no
+            # prefix plan (its pages carry the prefix content already) and
+            # no chunk lane (restore is one scatter, not a prefill)
+            bundle = self._swap_bundles.get(head.request_id)
             plan = None
-            if self._pool is not None:
+            if self._pool is not None and bundle is None:
                 try:
                     plan = self._prefix_plan(head.prompt, head.config)
                 except Exception:
@@ -2771,7 +3166,9 @@ class SlotServingEngine(ServingEngine):
             # lane check BEFORE the evicting gate: a head that cannot admit
             # this step anyway must not flush cached prefixes to make room
             # it cannot yet use
-            blocked, chunked = lane_blocked(plan)
+            blocked, chunked = (
+                (False, False) if bundle is not None else lane_blocked(plan)
+            )
             if blocked:
                 break
             if self._pool is not None:
@@ -2793,7 +3190,7 @@ class SlotServingEngine(ServingEngine):
                 # batch") — equal tiers still wait FIFO, so steady
                 # same-tier load cannot thrash residents.
                 while True:
-                    need = self._admit_need(head, plan)
+                    need = self._admit_need(head, plan, bundle)
                     if self._pool.can_reserve(need):
                         break
                     if not self._evict_for(need) and not (
@@ -2801,6 +3198,8 @@ class SlotServingEngine(ServingEngine):
                         and self._preempt_lower_tier(head)
                     ):
                         break
+                    if bundle is not None:
+                        continue
                     try:
                         plan = self._prefix_plan(head.prompt, head.config)
                     except Exception:
@@ -2832,12 +3231,30 @@ class SlotServingEngine(ServingEngine):
                     break
                 # eviction may have shrunk the plan and flipped the head
                 # onto the (busy) chunk lane — re-check before admitting
-                blocked, chunked = lane_blocked(plan)
+                blocked, chunked = (
+                    (False, False) if bundle is not None else lane_blocked(plan)
+                )
                 if blocked:
                     break
             req = self._queue.pop(0)
             if self._apply_request_chaos(req):
+                self._drop_bundle(req.request_id, cause="failover")
                 disposed += 1
+                continue
+            if bundle is not None:
+                del self._swap_bundles[req.request_id]
+                try:
+                    self._restore_admit(req, slot, bundle)
+                except Exception as e:
+                    # the restore scatter donates the slot state (non-CPU)
+                    # and may have half-written the pool either way —
+                    # _fail_resident releases every slot's pages, which
+                    # covers whatever pool.restore had re-mapped
+                    self._finish(req, "failed", error=f"{type(e).__name__}: {e}")
+                    return disposed + 1 + self._fail_resident(
+                        "swap-restore fault poisoned the slot state: "
+                        f"{type(e).__name__}: {e}"
+                    )
                 continue
             if chunked:
                 try:
@@ -3269,11 +3686,26 @@ class SlotServingEngine(ServingEngine):
             self._state, _ = self._spec_verify_executor()(
                 self._exec_params, self._state, cand0
             )
+        if paged and self.preemption in ("swap", "auto"):
+            # the host-swap pair (+2 on the compile bound): one dummy
+            # extract/restore round trip on the all-zero table (null-block
+            # trash both ways), so the first real victim compiles nothing
+            out0 = self._swap_extract_executor()(
+                self._state, row0, np.int32(0)
+            )
+            self._state = self._swap_restore_executor()(
+                self._state, out0, row0, np.int32(0), np.int32(0)
+            )
         if self._prefix_index is not None:
             # the state blank below zeroes the device pool; cached blocks
             # must not survive it
             self._prefix_index.flush(self._pool)
             self._update_kv_gauges()
+        # parked swap bundles (possible when warmup is re-run after
+        # traffic drained mid-queue) reference pool content the blank
+        # below zeroes — their requests fall back to replay-from-prompt
+        for rid in list(self._swap_bundles):
+            self._drop_bundle(rid, cause="retire")
         self._state = self._place_state(_blank_state(
             self.model, self.params, self.slots, cfg.pad_token_id,
             pool_tokens=self._pool_tokens() if paged else None,
@@ -3356,6 +3788,14 @@ class SlotServingEngine(ServingEngine):
                 "admit_headroom_blocks": self.admit_headroom_blocks,
                 "preemptions": int(counts.get("kv_preemptions_total", 0)),
                 "readmissions": int(counts.get("kv_readmissions_total", 0)),
+                # host-swap disposition (docs/serving.md "Host-swap
+                # preemption"): victims swapped out / bundles restored /
+                # bytes moved both directions / victims parked right now
+                "swaps": int(counts.get("kv_swaps_total", 0)),
+                "swap_restores": int(counts.get("kv_swap_restores_total", 0)),
+                "swap_bytes": int(counts.get("kv_swap_bytes_total", 0)),
+                "swapped_waiting": len(self._swap_bundles),
+                "swap_link_gbps": self.swap_link_gbps,
                 "by_tier": dict(sorted(self._preempted_by_tier.items())),
                 "by_tenant": dict(sorted(self._preempted_by_tenant.items())),
                 "headroom_blocks": self._pool.headroom_blocks,

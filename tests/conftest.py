@@ -88,6 +88,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "swap: host-swap preemption test (KV page extract/restore to host "
+        "memory, resume-in-place without prompt replay, per-victim "
+        "swap-vs-recompute auto arbitration, swap_gbps calibration; "
+        "serving/kv_pool.py, serving/slots.py, "
+        "inference/decode_strategy.py; docs/serving.md \"Host-swap "
+        "preemption\"); CPU-fast, runs in the tier-1 suite with a "
+        "per-test time budget",
+    )
+    config.addinivalue_line(
+        "markers",
         "slo: SLO telemetry test (per-token latency accounting, burn-rate "
         "monitor, load generator, telemetry-driven fleet admission; "
         "observability/slo.py, observability/loadgen.py; "

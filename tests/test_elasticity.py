@@ -650,6 +650,8 @@ def test_cli_autoscale_flag_group(tmp_path, tiny_model):
 
 
 # -- bench probe -------------------------------------------------------------
+@pytest.mark.slow  # 2026-08 audit: ~6s; real lane is `make elasticity` —
+# test_bench_probe.py keeps bench.py bitrot in tier-1
 def test_bench_elasticity_probe_tiny(tiny_model):
     """The bench.py elasticity probe at a reduced shape: the A/B runs end
     to end with the acceptance pins (zero dropped, token-identical,

@@ -483,6 +483,9 @@ def _read_stream_indexed(resp):
 
 @pytest.mark.speculative
 @pytest.mark.timeout(300)
+@pytest.mark.slow  # 2026-08 audit: ~9s; burst-frame ordering is re-proved at
+# the engine layer (test_speculative burst/ITL drill) — the SSE composition
+# re-proof moves to `slow` depth
 def test_gateway_speculative_burst_flushes_frames_in_index_order(tiny_model):
     """A speculative round that accepts a burst flushes one SSE frame PER
     token, in index order — never a coalesced multi-token frame, never out
@@ -524,6 +527,9 @@ def test_gateway_speculative_burst_flushes_frames_in_index_order(tiny_model):
 
 @pytest.mark.speculative
 @pytest.mark.timeout(300)
+@pytest.mark.slow  # 2026-08 audit: ~9s; replay dedup stays tier-1 in
+# test_fleet.py (hung-replica failover drill) — the speculative-burst
+# variant of the same cursor invariant moves to `slow` depth
 def test_gateway_speculative_failover_replay_no_duplicate_indices(tiny_model):
     """Crash a replica mid-burst: the fleet re-runs the stream's request on
     the survivor, whose replay re-emits indices from 0 — the gateway's
@@ -984,6 +990,9 @@ def test_every_gateway_family_has_direct_help(tiny_model):
 
 # -- bench probes -----------------------------------------------------------
 @pytest.mark.timeout(300)
+@pytest.mark.slow  # 2026-08 audit: ~4s; bench probes' real lane is their
+# make target (`make stream-bench`) and test_bench_probe.py keeps bench.py
+# import/CLI bitrot in tier-1
 def test_bench_streaming_probe_tiny(tiny_model):
     """Tiny end-to-end run of the extras.streaming probe: deterministic
     FakeClock abandonment with zero leak, closed accounting, survivor
@@ -1010,6 +1019,9 @@ def test_bench_streaming_probe_tiny(tiny_model):
 
 
 @pytest.mark.timeout(300)
+@pytest.mark.slow  # 2026-08 audit: ~6s; goodput accounting is pinned by
+# test_slo.py's unit drills — the sockets-transport probe re-proof rides
+# the `make slo` lane
 def test_bench_slo_goodput_http_transport_tiny(tiny_model):
     """The one-flag transport switch: the same slo_goodput probe runs its
     sweep over real sockets (GatewayHttpClient), reporting bytes-on-wire

@@ -233,7 +233,7 @@ def test_preemption_requires_paged_layout(tiny_model):
         SlotServingEngine(model, params, cfg, table, slots=2,
                           kv_layout="paged", preemption="recompute",
                           admit_headroom_blocks=-1)
-    assert PREEMPTION_MODES == ("off", "recompute")
+    assert PREEMPTION_MODES == ("off", "recompute", "swap", "auto")
 
 
 # -- token identity through preempt -> requeue -> readmit -> complete -------
@@ -584,6 +584,8 @@ def test_preemption_stats_gauges_and_report(tiny_model):
 
 
 # -- the bench probe ---------------------------------------------------------
+@pytest.mark.slow  # 2026-08 audit: ~6s; real lane is `make preemption` —
+# test_bench_probe.py keeps bench.py bitrot in tier-1
 def test_bench_preemption_probe_tiny(tiny_model):
     """The extras.preemption A/B at a pure-CPU tiny shape: optimistic
     admission packs more residents per HBM byte than strict worst-case

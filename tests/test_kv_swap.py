@@ -1,0 +1,673 @@
+"""Host-swap preemption (docs/serving.md "Host-swap preemption";
+``serving/kv_pool.py`` ``extract``/``restore``, ``serving/slots.py`` swap
+section, ``inference/decode_strategy.py`` ``swap_entries``).
+
+The load-bearing assertions:
+
+- **extract/restore as a unit**: ``extract`` splits the victim's mapped
+  run into a leading shared (refcounted) span — deref'd with one parking
+  retain each, never copied — and private pages freed into
+  ``frees_by_cause["swapped"]``; ``restore`` re-maps the bundle into
+  whatever free blocks exist at readmission (different ids are fine, the
+  block table indirects every access) and the pool balances to zero;
+- **resume, not replay**: a swapped victim readmits WITHOUT prompt
+  replay — no second first-token, no replayed tokens, the phase
+  decomposition still telescopes to ``unattributed_ms == 0.0``;
+- **token identity through swap-out/restore**: greedy output under
+  ``preemption="swap"``/``"auto"`` is identical to ``"recompute"`` and
+  to an unpressured run across paged / int8 / prefix-shared / chunked
+  geometries, including under a scripted ``kv.exhaust`` storm;
+- **every mid-swap retirement route drains the bundle**: cancel /
+  evacuate / failover / deadline expiry on a parked ``SwapBundle`` all
+  drop its parking retains — zero leak after each;
+- **the auto policy is honest**: each victim's disposition matches the
+  cheaper side of its own post-mortem record, and the measured transfer
+  calibrates a per-platform ``swap_gbps`` persisted beside
+  ``spec_entries``.
+
+All pure-CPU, tiny shapes — tier-1 (marker ``swap``).
+"""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.inference import decode_strategy as strategy_mod
+from perceiver_io_tpu.inference.generate import (
+    GenerationConfig,
+    executor_cache_stats,
+    generate,
+    reset_executor_caches,
+)
+from perceiver_io_tpu.inference.samplers import SamplingConfig
+from perceiver_io_tpu.models.text.clm import (
+    CausalLanguageModel,
+    CausalLanguageModelConfig,
+)
+from perceiver_io_tpu.observability import MetricsRegistry, StepTimeline
+from perceiver_io_tpu.observability.tracing import JsonlSpanSink, Tracer
+from perceiver_io_tpu.reliability import ChaosRegistry, FakeClock
+from perceiver_io_tpu.serving import BucketTable, KVPagePool, SlotServingEngine
+from perceiver_io_tpu.serving.kv_pool import PoolExhausted
+
+pytestmark = [pytest.mark.swap, pytest.mark.timeout(300)]
+
+KEY = jax.random.PRNGKey(0)
+
+# Deliberately NOT a shape other test modules use (executor cache keys
+# include the module fingerprint; an identically-configured model in
+# another file would pre-populate the cache this file counts).
+TINY = dict(
+    vocab_size=73, max_seq_len=32, max_latents=8, num_channels=16,
+    num_heads=2, num_self_attention_layers=1, cross_attention_dropout=0.0,
+)
+
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = CausalLanguageModelConfig(**TINY)
+    model = CausalLanguageModel(cfg)
+    params = model.init(KEY, jnp.zeros((1, 32), jnp.int32), 8)["params"]
+    return model, params
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    strategy_mod.reset_registry()
+    yield
+    strategy_mod.reset_registry()
+
+
+def _prompts(rng, lengths, vocab=73):
+    return [rng.integers(1, vocab, size=int(n)).astype(np.int32)
+            for n in lengths]
+
+
+def _ref(model, params, prompt, cfg):
+    return np.asarray(
+        generate(model, params, jnp.asarray(prompt[None, :]), cfg)
+    )[0]
+
+
+def _longtail(rng, n=6):
+    base = GenerationConfig(max_new_tokens=3, num_latents=2, sampling=GREEDY)
+    long_cfg = dataclasses.replace(base, max_new_tokens=14)
+    prompts = _prompts(rng, [5, 7, 6, 4, 7, 5][:n])
+    cfgs = [long_cfg if i % 2 else base for i in range(n)]
+    return prompts, cfgs
+
+
+def _engine(model, params, cfg, *, preemption="swap", kv_layout="paged",
+            slots=4, kv_blocks=10, prompt_lens=(8,), **kw):
+    table = BucketTable(prompt_lens=prompt_lens, batch_sizes=(1,))
+    kw.setdefault("clock", FakeClock())
+    return SlotServingEngine(
+        model, params, cfg, table, slots=slots, kv_layout=kv_layout,
+        kv_block_size=4, kv_blocks=kv_blocks, preemption=preemption,
+        admit_headroom_blocks=0, **kw
+    )
+
+
+# -- the extract/restore primitive as a unit ---------------------------------
+def test_extract_restore_unit_roundtrip():
+    """Extract splits shared-leading from private pages, frees only the
+    private ones (``swapped``), parks one retain per shared block; restore
+    re-maps into DIFFERENT free ids (an interloper took the originals) and
+    the pool still balances to zero."""
+    pool = KVPagePool(num_blocks=10, block_size=4, slots=3, max_len=32)
+    # publish a one-block prefix out of slot 2 (the index's retain)
+    pool.reserve(2, 4)
+    pool.ensure(2, 4)
+    prefix_block = pool.table_row(2)[0]
+    pool.retain(prefix_block)
+    pool.release(2)  # the index retain keeps it resident
+    # victim: shared prefix block + 2 private pages
+    pool.reserve(0, 12, shared_blocks=1)
+    pool.map_shared(0, [prefix_block])
+    pool.ensure(0, 12)
+    private_before = list(pool.table_row(0)[1:pool.mapped_blocks(0)])
+    in_use_before = pool.in_use
+    shared, private = pool.extract(0, cause="swapped")
+    assert shared == [prefix_block]
+    assert private == private_before
+    # private pages freed into the swapped bucket; the shared block stays
+    # allocated under the bundle's parking retain
+    assert pool.frees_by_cause.get("swapped", 0) == len(private)
+    assert pool.in_use == in_use_before - len(private)
+    # an interloper grabs the freed ids before readmission
+    pool.reserve(1, len(private) * 4)
+    pool.ensure(1, len(private) * 4)
+    taken = set(pool.table_row(1)[:pool.mapped_blocks(1)])
+    assert taken & set(private), "interloper should reuse the freed ids"
+    # restore: full worst-case reservation, shared re-mapped by reference,
+    # resident pages into whatever is free NOW
+    new_private = pool.restore(0, shared, total_tokens=12,
+                               resident_tokens=12)
+    assert pool.table_row(0)[0] == prefix_block
+    assert set(new_private).isdisjoint(taken)
+    assert set(new_private) != set(private)
+    # the slot re-references the shared block: drop the parking retain
+    pool.deref(prefix_block, cause="swapped")
+    pool.release(0)
+    pool.release(1)
+    pool.deref(prefix_block)  # the index retain
+    assert pool.leaked() == 0 and pool.in_use == 0
+
+
+def test_extract_restore_raise_semantics():
+    """Restore mirrors reserve(): double booking is a ValueError, a pool
+    that can't hold the worst case raises PoolExhausted with the table
+    untouched — and the parked retains survive the refused restore."""
+    pool = KVPagePool(num_blocks=6, block_size=4, slots=2, max_len=32)
+    pool.reserve(0, 12)
+    pool.ensure(0, 12)
+    shared, private = pool.extract(0)
+    assert shared == [] and len(private) == 3
+    pool.reserve(1, 16)  # 4 of 6 blocks spoken for
+    with pytest.raises(PoolExhausted):
+        pool.restore(0, shared, total_tokens=12, resident_tokens=12)
+    assert pool.mapped_blocks(0) == 0  # untouched on raise
+    pool.release(1)
+    pool.restore(0, shared, total_tokens=12, resident_tokens=12)
+    with pytest.raises(ValueError):
+        pool.restore(0, shared, total_tokens=12, resident_tokens=12)
+    pool.release(0)
+    assert pool.leaked() == 0
+
+
+# -- token identity through swap-out -> park -> restore -> complete ----------
+def test_swap_auto_recompute_identity_paged(tiny_model):
+    """The three preemption arms agree token-for-token with the
+    unpressured run on the plain paged pool, and the swap arm actually
+    swaps (pages through host memory, zero leak)."""
+    model, params = tiny_model
+    prompts, cfgs = _longtail(np.random.default_rng(3))
+
+    def run(preemption, kv_blocks):
+        eng = _engine(model, params, cfgs[0], preemption=preemption,
+                      kv_blocks=kv_blocks)
+        handles = [eng.submit(p, config=c) for p, c in zip(prompts, cfgs)]
+        eng.run_until_idle()
+        return eng, handles
+
+    _, ample = run(None, 32)
+    for mode in ("recompute", "swap", "auto"):
+        eng, hs = run(mode, 8)
+        pre = eng.stats()["preemption"]
+        assert pre["preemptions"] > 0
+        for h, a in zip(hs, ample):
+            assert h.status == "ok"
+            np.testing.assert_array_equal(h.result, a.result)
+        pool = eng._pool
+        assert pool.in_use == 0 and pool.leaked() == 0
+        assert eng.stats()["preemption"]["swapped_waiting"] == 0
+        if mode == "swap":
+            assert pre["swaps"] > 0 and pre["swap_restores"] > 0
+            assert pre["swap_bytes"] > 0
+            assert pool.frees_by_cause.get("swapped", 0) > 0
+            assert eng.registry.counter("kv_swaps_total") == pre["swaps"]
+
+
+@pytest.mark.parametrize("geometry", ["chunked", "prefix", "int8"])
+def test_swap_token_identity_geometries(tiny_model, geometry):
+    """Swap-out/restore is invisible across the hard geometries: a
+    chunked-prefill run (mid-admission victims fall back to recompute, a
+    RESIDENT victim still swaps), a prefix-shared victim (leading shared
+    blocks ride the bundle as references, never copies), and the int8
+    pool (quantized pages + per-block scales restore bit-identically vs
+    an UNPRESSURED int8 engine)."""
+    model, params = tiny_model
+    rng = np.random.default_rng(11)
+    prompts, cfgs = _longtail(rng)
+    kw = {}
+    layout = "paged"
+    if geometry == "chunked":
+        kw["prefill_chunk"] = 4
+    elif geometry == "prefix":
+        kw["prefix_cache"] = "on"
+        shared = prompts[0][:4]
+        prompts = [np.concatenate([shared, p]).astype(np.int32)[:8]
+                   for p in prompts]
+    else:
+        layout = "paged_int8"
+
+    def run(kv_blocks, preemption):
+        eng = _engine(model, params, cfgs[0], preemption=preemption,
+                      kv_layout=layout, kv_blocks=kv_blocks,
+                      prompt_lens=(8, 16), **kw)
+        handles = [eng.submit(p, config=c) for p, c in zip(prompts, cfgs)]
+        eng.run_until_idle()
+        return eng, handles
+
+    pressured, tight = run(8, "swap")
+    _, ample = run(32, None)
+    pre = pressured.stats()["preemption"]
+    assert pre["preemptions"] > 0
+    assert pre["swaps"] > 0 and pre["swap_restores"] > 0
+    for h_tight, h_ample in zip(tight, ample):
+        assert h_tight.status == "ok" and h_ample.status == "ok"
+        np.testing.assert_array_equal(h_tight.result, h_ample.result)
+    assert pressured._pool.leaked() == 0
+    assert pressured._pool.frees_by_cause.get("swapped", 0) > 0
+    if geometry != "prefix":
+        # prefix geometry legitimately retains published cache blocks at
+        # idle (referenced by the index, not leaked); the others drain
+        assert pressured._pool.in_use == 0
+
+
+def test_kv_exhaust_chaos_storm_swap_zero_leak(tiny_model):
+    """A scripted preemption storm under ``preemption="swap"``: every
+    request completes bitwise-identical to the fault-free run, every
+    bundle drains, and the pool balances to zero."""
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=6, num_latents=2, sampling=GREEDY)
+    prompts = _prompts(np.random.default_rng(13), [5, 7, 6, 4])
+
+    def run(chaos):
+        eng = _engine(model, params, cfg, kv_blocks=24, chaos=chaos)
+        handles = [eng.submit(p) for p in prompts]
+        eng.run_until_idle()
+        return eng, handles
+
+    _, clean = run(None)
+    chaos = ChaosRegistry()
+    chaos.exhaust_kv(2, count=4)  # steps 2-5 each force one exhaustion
+    engine, handles = run(chaos)
+    pre = engine.stats()["preemption"]
+    assert pre["preemptions"] >= 4
+    assert pre["swaps"] >= 4 and pre["swap_restores"] >= 1
+    for h, c in zip(handles, clean):
+        assert h.status == "ok"
+        np.testing.assert_array_equal(h.result, c.result)
+    pool = engine._pool
+    assert pool.in_use == 0 and pool.leaked() == 0
+    assert pool.allocs_total == pool.frees_total
+    assert pool.frees_by_cause.get("swapped", 0) >= 4
+    assert pre["swapped_waiting"] == 0
+    assert chaos.fired_count("kv.exhaust") == 4
+
+
+# -- every mid-swap retirement route drains the bundle -----------------------
+@pytest.mark.parametrize("route", ["cancel", "evacuate", "failover",
+                                   "deadline"])
+def test_bundle_drains_on_every_retirement_route(tiny_model, route):
+    """A parked SwapBundle (victim swapped out, not yet readmitted) is
+    dropped — parking retains included — by every retirement path that
+    can reach it: client cancel, fleet evacuation, an executor fault
+    failing the residents, and deadline expiry in the queue."""
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=14, num_latents=2, sampling=GREEDY)
+    clock = FakeClock()
+    chaos = ChaosRegistry()
+    engine = _engine(model, params, cfg, kv_blocks=8, clock=clock,
+                     chaos=chaos)
+    prompts = _prompts(np.random.default_rng(7), [6, 6])
+    deadline_s = 1.0 if route == "deadline" else None
+    victim = engine.submit(prompts[0], deadline_s=deadline_s)
+    survivor = engine.submit(prompts[1], deadline_s=deadline_s)
+    engine.step()  # both resident
+    chaos.exhaust_kv(chaos._counters.get("kv.exhaust", 0) + 1)
+    engine.step()  # the storm swaps one victim out
+    pre = engine.stats()["preemption"]
+    assert pre["swaps"] >= 1
+    assert pre["swapped_waiting"] >= 1
+    swapped_ids = set(engine._swap_bundles)
+    target = victim if victim.request_id in swapped_ids else survivor
+    if route == "cancel":
+        assert engine.cancel(target.request_id)
+        assert target.status == "cancelled"
+    elif route == "evacuate":
+        engine.evacuate("scale_down")
+    elif route == "failover":
+        chaos.fail_batch(chaos._counters.get("serving.batch", 0) + 1)
+        engine.step()
+    else:
+        # both requests carry the deadline, so the parked one expires in
+        # the queue regardless of which resident the policy chose
+        clock.advance(5.0)
+        engine.step()
+    engine.run_until_idle()
+    assert engine.stats()["preemption"]["swapped_waiting"] == 0
+    assert not engine._swap_bundles
+    pool = engine._pool
+    assert pool.in_use == 0 and pool.leaked() == 0
+    assert pool.allocs_total == pool.frees_total
+
+
+def test_warmup_and_resize_drop_parked_bundles(tiny_model):
+    """State rebuilds (resize_slots) and warmup's state blank invalidate
+    parked bundles — their device-side shared blocks belong to the
+    OUTGOING pool — instead of restoring stale KV into a fresh state."""
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=14, num_latents=2, sampling=GREEDY)
+    chaos = ChaosRegistry()
+    engine = _engine(model, params, cfg, kv_blocks=8, chaos=chaos)
+    handles = [engine.submit(p)
+               for p in _prompts(np.random.default_rng(5), [6, 6])]
+    engine.step()
+    chaos.exhaust_kv(chaos._counters.get("kv.exhaust", 0) + 1)
+    engine.step()
+    assert engine.stats()["preemption"]["swapped_waiting"] >= 1
+    # resize requires an idle engine: cancel the surviving resident, the
+    # parked bundle + its queued victim stay live across the rebuild
+    for h in handles:
+        if h.request_id not in engine._swap_bundles and h.status == "queued":
+            engine.cancel(h.request_id)
+    engine.resize_slots(engine.slots + 1)
+    assert not engine._swap_bundles
+    engine.run_until_idle()  # the de-bundled request replays from prompt
+    assert engine._pool.leaked() == 0
+    assert engine.stats()["preemption"]["swapped_waiting"] == 0
+
+
+# -- the auto policy is honest ------------------------------------------------
+def test_auto_arbitration_matches_postmortem_records(tiny_model):
+    """Every ``auto`` victim's disposition is the cheaper side of its own
+    post-mortem record. Under FakeClock the measured decode step is 0 ms,
+    so recompute (0 ms) always wins; under a real clock with long decode
+    tails the swap side must actually get picked."""
+    model, params = tiny_model
+    prompts, cfgs = _longtail(np.random.default_rng(3))
+
+    def drill(**kw):
+        eng = _engine(model, params, cfgs[0], preemption="auto",
+                      kv_blocks=8, **kw)
+        for p, c in zip(prompts, cfgs):
+            eng.submit(p, config=c)
+        eng.run_until_idle()
+        return eng
+
+    fake = drill()  # FakeClock via _engine default
+    recent = fake.postmortems()["recent"]
+    assert recent and all(r["mode"] == "recompute" for r in recent)
+    assert fake.stats()["preemption"]["swaps"] == 0
+    # real clock: decode steps cost real milliseconds, a victim's page
+    # footprint transfers in microseconds — swap must win somewhere
+    import time as _time
+    real = drill(clock=_time.monotonic)
+    seen = set()
+    for r in real.postmortems()["recent"]:
+        cheaper = ("swap" if r["swap_est_ms"] < r["recompute_est_ms"]
+                   else "recompute")
+        assert r["mode"] == cheaper, r
+        seen.add(r["mode"])
+    assert "swap" in seen
+    assert real.stats()["preemption"]["swaps"] > 0
+    assert real._pool.leaked() == 0
+
+
+def test_swap_calibration_ema_and_registry(tiny_model):
+    """A measured transfer folds into the live link rate (equal-weight
+    EMA) and the per-platform registry; zero-duration transfers (the
+    FakeClock case) never poison the model."""
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=3, num_latents=2, sampling=GREEDY)
+    engine = _engine(model, params, cfg, swap_link_gbps=4.0)
+    assert engine.swap_link_gbps == 4.0
+    engine._calibrate_swap(16_000_000_000, 0.0)  # FakeClock guard: no-op
+    assert engine.swap_link_gbps == 4.0
+    assert strategy_mod.lookup_swap_gbps() is None
+    engine._calibrate_swap(16_000_000_000, 1.0)  # measured 16 GB/s
+    assert engine.swap_link_gbps == pytest.approx(10.0)  # (4 + 16) / 2
+    assert strategy_mod.lookup_swap_gbps() == pytest.approx(10.0)
+    entry = strategy_mod.swap_entry()
+    assert entry["bytes_moved"] == 16_000_000_000
+    # a fresh engine with NO explicit rate resolves the calibrated value;
+    # after reset it falls back to the 16.0 prior
+    assert _engine(model, params, cfg).swap_link_gbps == pytest.approx(10.0)
+    strategy_mod.reset_registry()
+    assert _engine(model, params, cfg).swap_link_gbps == 16.0
+    with pytest.raises(ValueError):
+        strategy_mod.record_swap_gbps(0.0)
+
+
+def test_swap_registry_persistence_roundtrip(tmp_path):
+    """``swap_entries`` persist beside ``spec_entries`` in the strategy
+    artifact and survive a save/load cycle; malformed entries degrade to
+    re-measurement (skipped on load) instead of taking serving down."""
+    strategy_mod.record_swap_gbps(12.5, platform="faketpu",
+                                  bytes_moved=4096, last_transfer_ms=0.33)
+    path = str(tmp_path / "strategy.json")
+    strategy_mod.save_registry(path)
+    data = json.load(open(path))
+    assert data["swap_entries"] == [{
+        "platform": "faketpu", "swap_gbps": 12.5, "bytes_moved": 4096,
+        "last_transfer_ms": 0.33,
+    }]
+    strategy_mod.reset_registry()
+    assert strategy_mod.lookup_swap_gbps("faketpu") is None
+    strategy_mod.load_registry(path)
+    assert strategy_mod.lookup_swap_gbps("faketpu") == pytest.approx(12.5)
+    assert strategy_mod.swap_entry("faketpu")["bytes_moved"] == 4096
+    bad = str(tmp_path / "bad.json")
+    data["swap_entries"] = [{"platform": "x", "swap_gbps": -1}]
+    json.dump(data, open(bad, "w"))
+    strategy_mod.load_registry(bad)  # corrupt rate: skipped, not loaded
+    assert strategy_mod.lookup_swap_gbps("x") is None
+
+
+# -- observability surfaces ---------------------------------------------------
+@pytest.fixture(scope="module")
+def swap_drill(tiny_model, tmp_path_factory):
+    """One deterministic FakeClock swap drill shared by the obs tests:
+    genuine pool pressure under ``preemption="swap"`` with the timeline
+    ring and a JSONL span sink attached, fully drained."""
+    model, params = tiny_model
+    tmp = tmp_path_factory.mktemp("swap_drill")
+    ev_path = str(tmp / "events.jsonl")
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    sink = JsonlSpanSink(ev_path)
+    tracer = Tracer(clock=clock, sink=sink)
+    eng = SlotServingEngine(
+        model=model, params=params,
+        config=GenerationConfig(max_new_tokens=8, sampling=GREEDY),
+        table=BucketTable(prompt_lens=(8,), batch_sizes=(1,)),
+        slots=4, kv_layout="paged", kv_block_size=4, kv_blocks=10,
+        preemption="swap", clock=clock, registry=reg, tracer=tracer,
+    )
+    eng.timeline = StepTimeline(cap=256, registry=reg)
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        prompt = rng.integers(1, 70, size=6).astype(np.int32)
+        eng.submit(
+            prompt,
+            config=GenerationConfig(
+                max_new_tokens=3 if i % 2 == 0 else 14, sampling=GREEDY
+            ),
+            tenant="acme" if i % 3 == 0 else None,
+        )
+        clock.advance(0.001)
+    while eng.pending():
+        eng.step()
+        clock.advance(0.002)
+    sink.close()
+    from perceiver_io_tpu.observability.tracing import read_events_jsonl
+    return {
+        "engine": eng, "registry": reg,
+        "records": eng.timeline.records(),
+        "events": read_events_jsonl(ev_path),
+    }
+
+
+def test_swap_timeline_rows_and_span_join(swap_drill):
+    """``swapped``/``restored`` step-record entries carry the transfer
+    facts, the matching ``serving.swapped``/``serving.restored`` span
+    events land inside the covering step record, and the ring summary +
+    analyzer accounting count both families."""
+    records, events = swap_drill["records"], swap_drill["events"]
+    swapped = [e for r in records for e in r.get("swapped") or []]
+    restored = [e for r in records for e in r.get("restored") or []]
+    assert swapped and restored
+    for e in swapped:
+        assert e["pages"] > 0 and e["bytes"] > 0
+        assert {"request_id", "slot", "shared_blocks", "ms"} <= set(e)
+    for e in restored:
+        assert e["tokens_resident"] > 0 and e["bytes"] > 0
+    for span, kind in (("serving.swapped", "swapped"),
+                       ("serving.restored", "restored")):
+        evs = [e for e in events if e.get("span") == span]
+        assert evs, f"drill produced no {span} events"
+        for ev in evs:
+            hits = [
+                entry
+                for rec in records
+                if rec["t_start_s"] - 1e-6 <= ev["start_s"]
+                <= rec["t_end_s"] + 1e-6
+                for entry in rec.get(kind, ())
+                if entry["slot"] == ev["attrs"]["slot"]
+                and entry["bytes"] == ev["attrs"]["bytes"]
+            ]
+            assert hits, f"{span} missing from step records"
+    summary = swap_drill["engine"].timeline.summary()
+    assert summary["events"]["swapped"] == len(swapped)
+    assert summary["events"]["restored"] == len(restored)
+    from perceiver_io_tpu.observability.report import analyze_timeline
+    an = analyze_timeline(records, events)
+    assert an["events"]["swapped"] == len(swapped)
+    assert an["accounting"]["swapped"] == len(swapped)
+    assert an["accounting"]["restored"] == len(restored)
+
+
+def test_swap_resumes_without_replay_and_telescopes(swap_drill):
+    """The resume-not-replay bar: restored requests show ONE admission
+    attempt and ZERO replayed tokens in the per-request decomposition,
+    and the swap legs keep the exactness bar — ``unattributed_ms == 0.0``
+    for every request under FakeClock."""
+    from perceiver_io_tpu.observability.report import analyze_timeline
+
+    records, events = swap_drill["records"], swap_drill["events"]
+    an = analyze_timeline(records, events,
+                          snapshot=swap_drill["registry"].snapshot())
+    rows = an["requests"]
+    assert len(rows) == 8
+    for row in rows:
+        assert row["span_ms"] is not None
+        assert row["unattributed_ms"] == 0.0, row
+        assert row["attempts"] == 1 and row["replayed_tokens"] == 0, row
+    swapped_rids = {e["request_id"] for r in records
+                    for e in r.get("swapped") or []}
+    assert swapped_rids  # the drill really swapped someone
+    # no second `admitted` entry for a restored request: readmission goes
+    # through `restored`, not a fresh admission arc
+    for rid in swapped_rids:
+        admits = [e for r in records for e in r.get("admitted") or []
+                  if e["request_id"] == rid]
+        assert len(admits) == 1
+
+
+def test_swap_gantt_chrome_and_report_surfaces(swap_drill):
+    """The rendered surfaces carry the swap rows: gantt S/R glyphs +
+    legend, chrome-trace swap/restore lifecycle instants, the kv-pool
+    report section's host-swap rollup, and HELP_TEXT for every new
+    family."""
+    from perceiver_io_tpu.observability.exporters import HELP_TEXT
+    from perceiver_io_tpu.observability.report import (
+        _kv_pool_section,
+        analyze_timeline,
+        chrome_trace,
+        format_timeline,
+        timeline_gantt,
+    )
+
+    records, events = swap_drill["records"], swap_drill["events"]
+    lines = timeline_gantt(records)
+    assert "S=swapped out" in lines[-1] and "R=restored" in lines[-1]
+    body = "\n".join(lines[:-1])  # grid rows, legend excluded
+    assert "S" in body and "R" in body
+    trace = chrome_trace(records, events)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any(n.startswith("swap req") for n in names)
+    assert any(n.startswith("restore req") for n in names)
+    for fam in ("kv_swaps_total", "kv_swap_restores_total",
+                "kv_swap_bytes_total", "kv_swap_ms"):
+        assert fam in HELP_TEXT, fam
+    reg = swap_drill["registry"]
+    snap = reg.snapshot()
+    section = _kv_pool_section(snap)
+    pre = swap_drill["engine"].stats()["preemption"]
+    assert section["preemption"]["swaps"] == pre["swaps"] > 0
+    assert section["preemption"]["swap_restores"] == pre["swap_restores"]
+    assert section["preemption"]["swap_bytes"] == pre["swap_bytes"] > 0
+    rendered = format_timeline(
+        analyze_timeline(records, events, snapshot=snap), records
+    )
+    assert "swapped=" in rendered and "restored=" in rendered
+
+
+# -- CLI wiring ---------------------------------------------------------------
+def test_cli_swap_flag_rejects(tiny_model, tmp_path):
+    """The inapplicable-flag convention: ``--serve.swap_gbps`` without a
+    swap mode, a non-positive rate, and any swap flag on the bucket
+    engine all reject loudly instead of silently doing nothing."""
+    from perceiver_io_tpu.scripts.text import clm as clm_script
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    model, params = tiny_model
+    ckpt = str(tmp_path / "ckpt")
+    save_pretrained(ckpt, params, model.config)
+    base = ["serve", "--ckpt", ckpt, "--serve.max_new_tokens=2",
+            "--serve.num_latents=2", "--serve.warmup=false"]
+    with pytest.raises(SystemExit, match="swap_gbps applies with"):
+        clm_script.main(base + ["--serve.swap_gbps=8"])
+    with pytest.raises(SystemExit, match="swap_gbps must be > 0"):
+        clm_script.main(base + ["--serve.preemption=swap",
+                                "--serve.engine=slots",
+                                "--serve.swap_gbps=0"])
+    with pytest.raises(SystemExit, match="page pool"):
+        clm_script.main(base + ["--serve.engine=bucket",
+                                "--serve.preemption=swap"])
+    with pytest.raises(SystemExit, match="preemption must be one of"):
+        clm_script.main(base + ["--serve.engine=slots",
+                                "--serve.preemption=dma"])
+
+
+def test_ctor_validation(tiny_model):
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=4, num_latents=2, sampling=GREEDY)
+    table = BucketTable(prompt_lens=(8,), batch_sizes=(1,))
+    with pytest.raises(ValueError, match="paged"):
+        SlotServingEngine(model, params, cfg, table, slots=2,
+                          kv_layout="dense", preemption="swap")
+    with pytest.raises(ValueError, match="swap_link_gbps"):
+        SlotServingEngine(model, params, cfg, table, slots=2,
+                          kv_layout="paged", preemption="swap",
+                          swap_link_gbps=0.0)
+
+
+# -- compile bound -----------------------------------------------------------
+# Runs LAST: reset_executor_caches() wipes every warm executor this module
+# built, so an earlier position would force the later drills to recompile.
+def test_compile_bound_swap_pair_and_zero_retrace(tiny_model):
+    """Swap preemption adds EXACTLY the extract/restore pair to the
+    engine's warmup compile bound, and post-warmup swap traffic —
+    transfers included — retraces nothing."""
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=14, num_latents=2, sampling=GREEDY)
+    table = BucketTable(prompt_lens=(8,), batch_sizes=(1,))
+    reset_executor_caches()
+    base = SlotServingEngine(
+        model, params, cfg, table, slots=4, kv_layout="paged",
+        kv_block_size=4, kv_blocks=8, preemption="recompute",
+        clock=FakeClock(),
+    )
+    base.warmup()
+    miss0 = executor_cache_stats()["misses"]
+    swap = SlotServingEngine(
+        model, params, cfg, table, slots=4, kv_layout="paged",
+        kv_block_size=4, kv_blocks=8, preemption="swap", clock=FakeClock(),
+    )
+    swap.warmup()
+    assert executor_cache_stats()["misses"] == miss0 + 2
+    before = executor_cache_stats()["misses"]
+    prompts, cfgs = _longtail(np.random.default_rng(3))
+    handles = [swap.submit(p, config=c) for p, c in zip(prompts, cfgs)]
+    swap.run_until_idle()
+    assert swap.stats()["preemption"]["swaps"] > 0
+    assert all(h.status == "ok" for h in handles)
+    assert executor_cache_stats()["misses"] == before, "retraced after warmup"

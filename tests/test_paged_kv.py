@@ -380,6 +380,8 @@ def test_engine_kv_layout_env_resolution(tiny_model, monkeypatch):
 
 
 # -- bench probe ------------------------------------------------------------
+@pytest.mark.slow  # 2026-08 audit: ~6s; real lane is `make paged-bench` —
+# test_bench_probe.py keeps bench.py bitrot in tier-1
 def test_bench_paged_kv_probe_tiny(tiny_model):
     """The extras.paged_kv A/B at a pure-CPU tiny shape: the paged pool
     admits strictly more concurrent residents than dense at the same

@@ -816,6 +816,8 @@ def test_workload_shared_prefix_zipf_deterministic_end_to_end(tiny_model):
 
 
 # -- bench probe ------------------------------------------------------------
+@pytest.mark.slow  # 2026-08 audit: ~6s; real lane is `make prefix-bench` —
+# test_bench_probe.py keeps bench.py bitrot in tier-1
 def test_bench_prefix_cache_probe_tiny(tiny_model):
     """The extras.prefix_cache A/B at a pure-CPU tiny shape: outputs
     token-identical between arms, hits recorded, the shared arm packs at

@@ -798,6 +798,8 @@ def test_every_paged_slot_engine_family_has_direct_help(tiny_model):
 
 # -- bench probe ------------------------------------------------------------
 @pytest.mark.timeout(300)
+@pytest.mark.slow  # 2026-08 audit: ~6s; real lane is `make slo` —
+# test_bench_probe.py keeps bench.py bitrot in tier-1
 def test_bench_slo_goodput_probe_tiny(tiny_model):
     """Tiny end-to-end sweep through the real bench probe: the record
     carries the goodput-under-SLO curve (p95 TTFT / p95 ITL per offered
